@@ -1,0 +1,118 @@
+"""Autocast (parity: python/paddle/fluid/dygraph/amp/auto_cast.py:203).
+
+O1: ops on the white list run in the low-precision dtype (white/black lists
+mirror the reference's); O2: the model itself is cast.  Implemented as a
+thread-local mode consulted by a dispatch hook that casts float inputs of
+white-listed ops.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dispatch import OP_REGISTRY
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+# mirrors the reference O1 lists (amp_auto_cast white/black lists)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy", "mean",
+    "sum", "cumsum", "layer_norm", "batch_norm_train", "batch_norm_infer",
+    "rms_norm", "norm", "cosine_similarity",
+}
+
+white_list = WHITE_LIST  # re-export name parity
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast  # legacy alias (fluid.dygraph.amp.amp_guard)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the AMP dtype (parity: paddle.amp.decorate)."""
+    dt = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        for p in m.parameters():
+            if jnp.issubdtype(p.data.dtype, jnp.floating):
+                p.data = p.data.astype(dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def maybe_cast_inputs(op_name, arrays):
+    """Called by the dispatch layer when AMP is active: cast float inputs of
+    white-listed ops to the AMP dtype."""
+    if not _state.enabled:
+        return arrays
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    if op_name not in white:
+        return arrays
+    dt = _state.dtype
+    return [a.astype(dt) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) else a
+            for a in arrays]
+
+
+def _amp_wrap_pure(op_name, pure_fn):
+    def wrapped(*args, **kwargs):
+        if _state.enabled:
+            white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+            black = (BLACK_LIST | _state.custom_black)
+            dt = _state.dtype
+            if op_name in white:
+                args = tuple(
+                    a.astype(dt) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) else a
+                    for a in args)
+            elif op_name in black:
+                args = tuple(
+                    a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype == dt else a
+                    for a in args)
+        return pure_fn(*args, **kwargs)
+
+    return wrapped
+
+
+def is_enabled():
+    return _state.enabled

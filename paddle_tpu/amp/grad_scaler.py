@@ -1,0 +1,97 @@
+"""GradScaler (parity: python/paddle/amp/grad_scaler.py:26).
+
+On TPU the default AMP dtype is bf16, whose exponent range matches fp32 —
+dynamic loss scaling is unnecessary, so with ``enable=True`` under bf16 this
+is an API-compatible passthrough (scale factor 1, no inf checks).  When the
+user explicitly trains fp16, the reference's dynamic loss-scaling state
+machine (check_finite_and_unscale + update_loss_scaling ops) runs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=None):
+        self._enable = enable
+        # bf16-native: scaling only activates if the user opts into dynamic
+        # loss scaling (fp16 path)
+        self._use_dynamic = (use_dynamic_loss_scaling
+                             if use_dynamic_loss_scaling is not None else False)
+        self._scale = float(init_loss_scaling) if self._use_dynamic else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable or self._scale == 1.0:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._scale == 1.0:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad.data * inv
+                found_inf = found_inf or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._scale != 1.0:
+            self.unscale_(optimizer)
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            optimizer.step()
+            self._good_steps += 1
+            if self._use_dynamic and self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def update(self):
+        pass
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

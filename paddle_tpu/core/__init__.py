@@ -1,0 +1,15 @@
+from . import autograd, dispatch, dtype, flags, place, random, tensor  # noqa: F401
+from .autograd import enable_grad, is_grad_enabled, no_grad  # noqa: F401
+from .dispatch import OP_REGISTRY, get_op, list_ops, register_op  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401

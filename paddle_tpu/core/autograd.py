@@ -1,0 +1,178 @@
+"""Eager autograd engine.
+
+TPU-native analog of the reference's gen-2 eager autograd
+(paddle/fluid/eager/autograd_meta.h:68 ``AutogradMeta``,
+grad_node_info.h:90 ``GradNodeBase``, backward.cc:522 ``RunBackward``).
+
+Design: instead of hand-written per-op grad kernels, every eager op captures a
+``jax.vjp`` closure at forward time (residuals live on device).  ``backward()``
+does a reverse-topological walk over the recorded ``TapeNode`` graph, calls
+each node's vjp, and accumulates cotangents — the exact role of
+``GradTensorHolder`` + in-degree counting in the reference, with XLA owning
+the kernel-level differentiation.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+
+import jax.numpy as jnp
+
+__all__ = ["no_grad", "enable_grad", "is_grad_enabled", "TapeNode", "run_backward"]
+
+
+class _GradMode(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_mode = _GradMode()
+
+
+def is_grad_enabled() -> bool:
+    return _mode.enabled
+
+
+class _set_grad_enabled(contextlib.ContextDecorator):
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self.prev = None
+
+    def __enter__(self):
+        self.prev = _mode.enabled
+        _mode.enabled = self.enabled
+        return self
+
+    def __exit__(self, *exc):
+        _mode.enabled = self.prev
+        return False
+
+
+def no_grad():
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+    return _set_grad_enabled(False)
+
+
+def enable_grad():
+    return _set_grad_enabled(True)
+
+
+class TapeNode:
+    """One recorded op: vjp closure + graph edges.
+
+    ``inputs``: the Tensor objects the vjp differentiates w.r.t. (order =
+    vjp cotangent order).  ``outputs``: weakrefs to produced Tensors.
+    """
+
+    __slots__ = ("op_name", "vjp_fn", "inputs", "out_refs", "out_avals",
+                 "n_outputs", "__weakref__")
+
+    def __init__(self, op_name, vjp_fn, inputs, outputs):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_refs = [weakref.ref(t) for t in outputs]
+        # shape/dtype per output so zero cotangents survive output GC
+        self.out_avals = [(t.data.shape, t.data.dtype) for t in outputs]
+        self.n_outputs = len(outputs)
+
+    def parents(self):
+        for t in self.inputs:
+            node = t._node
+            if node is not None:
+                yield node
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+
+
+def run_backward(root, grad=None, retain_graph=False):
+    """Reverse-mode walk from ``root`` (parity: egr::Backward, backward.cc:801)."""
+    root_node = root._node
+    if root_node is None:
+        # leaf with no history: grad flows nowhere; still set .grad for parity
+        if grad is None and root.data.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad"
+            )
+        if not root.stop_gradient:
+            g = jnp.ones_like(root.data) if grad is None else _as_array(grad)
+            root._accum_grad(g)
+        return
+
+    if grad is None:
+        if root.data.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad"
+            )
+        grad = jnp.ones_like(root.data)
+    else:
+        grad = _as_array(grad)
+
+    # topological order (DFS, iterative)
+    topo, seen = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            topo.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for p in node.parents():
+            if id(p) not in seen:
+                stack.append((p, False))
+
+    # cotangent accumulation keyed by tensor identity
+    cotangents: dict[int, object] = {id(root): grad}
+    keepalive = {id(root): root}
+
+    for node in reversed(topo):
+        cts_in = []
+        has_any = False
+        for ref in node.out_refs:
+            t = ref()
+            ct = cotangents.get(id(t)) if t is not None else None
+            if ct is not None:
+                has_any = True
+            cts_in.append(ct)
+        if not has_any:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"trying to backward through op '{node.op_name}' a second "
+                "time: the saved graph was freed. Pass retain_graph=True to "
+                "the first backward() call."
+            )
+        # build full cotangent tuple (zeros where an output is unused or GC'd)
+        cts = []
+        for i, ct in enumerate(cts_in):
+            if ct is None:
+                shape, dtype = node.out_avals[i]
+                cts.append(jnp.zeros(shape, dtype))
+            else:
+                cts.append(ct)
+        in_grads = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        for t, g in zip(node.inputs, in_grads):
+            if t.stop_gradient or g is None:
+                continue
+            tid = id(t)
+            if t._node is None or t._retain_grads:
+                t._accum_grad(g)
+            if tid in cotangents:
+                cotangents[tid] = cotangents[tid] + g
+            else:
+                cotangents[tid] = g
+                keepalive[tid] = t
+        if not retain_graph:
+            node.release()
+
+
+def _as_array(x):
+    from .tensor import Tensor
+
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)

@@ -1,0 +1,151 @@
+"""Op registry + eager dispatch.
+
+TPU-native analog of the reference's kernel registry & dispatch chain
+(paddle/phi/core/kernel_factory.h:50,211,261 KernelKey/KernelFactory;
+kernel_registry.h:346 PD_REGISTER_KERNEL; eager dispatch via generated
+dygraph functions → paddle::experimental API → kernel_dispatch.h).
+
+Design: one registration point per op.  An op is a *pure jax function*
+(arrays in, array/tuple-of-arrays out).  Registration produces the public
+eager wrapper which (a) unwraps Tensors, (b) captures a ``jax.vjp`` closure
+when autograd is live (the PreparedOp/grad-node creation step,
+prepared_operator.cc:142), (c) wraps outputs and links the tape.  There is no
+per-backend kernel table: XLA *is* the backend, and per-op Pallas overrides
+register the same way (the pure fn internally picks pallas vs lax).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .autograd import TapeNode, is_grad_enabled
+from .flags import flag
+from .tensor import Tensor
+
+__all__ = ["register_op", "get_op", "list_ops", "OP_REGISTRY"]
+
+OP_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = ("name", "pure_fn", "eager_fn", "differentiable")
+
+    def __init__(self, name, pure_fn, eager_fn, differentiable):
+        self.name = name
+        self.pure_fn = pure_fn
+        self.eager_fn = eager_fn
+        self.differentiable = differentiable
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _differentiable_leaf(t: Tensor) -> bool:
+    return (not t.stop_gradient) and jnp.issubdtype(t.data.dtype, jnp.inexact)
+
+
+def register_op(name=None, differentiable=True, nondiff_argnums=()):
+    """Register a pure jax function as a framework op.
+
+    The returned callable is the eager entry point; the pure function stays
+    reachable via ``get_op(name).pure_fn`` for jit tracing and the OpTest
+    conformance harness.
+    """
+
+    def deco(pure_fn):
+        op_name = name or pure_fn.__name__
+
+        @functools.wraps(pure_fn)
+        def eager(*args, **kwargs):
+            return _eager_run(op_name, pure_fn, differentiable, args, kwargs)
+
+        OP_REGISTRY[op_name] = OpDef(op_name, pure_fn, eager, differentiable)
+        eager.pure_fn = pure_fn
+        eager.op_name = op_name
+        return eager
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OP_REGISTRY[name]
+
+
+def list_ops():
+    return sorted(OP_REGISTRY)
+
+
+def _eager_run(op_name, pure_fn, differentiable, args, kwargs):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_is_tensor_leaf
+    )
+
+    tracking = differentiable and is_grad_enabled()
+    diff_idx = []
+    diff_tensors = []
+    plain_leaves = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Tensor):
+            if tracking and _differentiable_leaf(leaf):
+                diff_idx.append(i)
+                diff_tensors.append(leaf)
+                plain_leaves.append(None)  # placeholder
+            else:
+                plain_leaves.append(leaf.data)
+        else:
+            plain_leaves.append(leaf)
+
+    fn = pure_fn
+    try:
+        from ..amp.auto_cast import _amp_wrap_pure, is_enabled
+
+        if is_enabled():
+            fn = _amp_wrap_pure(op_name, pure_fn)
+    except ImportError:
+        pass
+
+    def call(*diff_arrays):
+        it = iter(diff_arrays)
+        full = list(plain_leaves)
+        for i in diff_idx:
+            full[i] = next(it)
+        a, kw = jax.tree_util.tree_unflatten(treedef, full)
+        return fn(*a, **kw)
+
+    if diff_tensors:
+        out, vjp_fn = jax.vjp(call, *(t.data for t in diff_tensors))
+        out_is_tuple = isinstance(out, (tuple, list))
+        outs = list(out) if out_is_tuple else [out]
+        wrapped = [Tensor(o, stop_gradient=False) for o in outs]
+        node = TapeNode(op_name, vjp_fn, diff_tensors, wrapped)
+        for w in wrapped:
+            w._node = node
+    else:
+        out = call()
+        out_is_tuple = isinstance(out, (tuple, list))
+        outs = list(out) if out_is_tuple else [out]
+        wrapped = [Tensor(o, stop_gradient=True) for o in outs]
+
+    if flag("check_nan_inf"):
+        _check_nan_inf(op_name, outs)
+
+    if out_is_tuple:
+        return tuple(wrapped)
+    return wrapped[0]
+
+
+def _check_nan_inf(op_name, arrays):
+    """FLAGS_check_nan_inf parity (nan_inf_utils_detail.cc:570)."""
+    for i, a in enumerate(arrays):
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            bad = bool(jnp.any(~jnp.isfinite(a)))
+            if bad:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output {i} of op '{op_name}'"
+                )

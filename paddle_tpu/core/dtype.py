@@ -1,0 +1,112 @@
+"""Dtype registry.
+
+Mirrors the reference's VarType dtype enum (paddle/fluid/framework/framework.proto:117)
+with paddle-style string names, mapped onto jax/numpy dtypes.  bfloat16 is a
+first-class citizen (TPU-native AMP dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "bool_",
+    "complex64",
+    "complex128",
+    "convert_dtype",
+    "get_default_dtype",
+    "set_default_dtype",
+    "is_floating_dtype",
+    "is_integer_dtype",
+]
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+dtype = jnp.dtype
+
+_ALIASES = {
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = [float32]
+
+
+def convert_dtype(dt):
+    """Normalize any dtype spelling to a jnp dtype."""
+    if dt is None:
+        return None
+    if isinstance(dt, str):
+        key = dt.lower()
+        if key in _ALIASES:
+            return jnp.dtype(_ALIASES[key])
+        return jnp.dtype(key)
+    return jnp.dtype(dt)
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def set_default_dtype(dt):
+    _default_dtype[0] = convert_dtype(dt)
+
+
+def is_floating_dtype(dt):
+    dt = convert_dtype(dt)
+    return jnp.issubdtype(dt, jnp.floating)
+
+
+def is_integer_dtype(dt):
+    dt = convert_dtype(dt)
+    return jnp.issubdtype(dt, jnp.integer)
+
+
+def numpy_dtype(dt):
+    dt = convert_dtype(dt)
+    if dt == jnp.dtype(bfloat16):
+        # numpy has no native bfloat16; ml_dtypes provides the numpy scalar
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dt)

@@ -1,0 +1,71 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's gflags system
+(paddle/fluid/platform/flags.cc ``PADDLE_DEFINE_EXPORTED_*``; env bootstrap at
+python/paddle/fluid/__init__.py:150).  Flags are defined in one place, can be
+overridden by ``FLAGS_<name>`` environment variables at import, and
+get/set at runtime via ``get_flags``/``set_flags``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+_lock = threading.Lock()
+_registry: dict[str, dict] = {}
+
+
+def _coerce(value, proto):
+    if isinstance(proto, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(proto, int):
+        return int(value)
+    if isinstance(proto, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    """Register a flag; env var ``FLAGS_<name>`` overrides the default."""
+    with _lock:
+        env = os.environ.get(f"FLAGS_{name}")
+        value = _coerce(env, default) if env is not None else default
+        _registry[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def flag(name: str):
+    """Read a flag's current value."""
+    return _registry[name]["value"]
+
+
+def get_flags(names=None):
+    if names is None:
+        names = list(_registry)
+    if isinstance(names, str):
+        names = [names]
+    return {n: _registry[n]["value"] for n in names}
+
+
+def set_flags(mapping: dict):
+    with _lock:
+        for name, value in mapping.items():
+            if name.startswith("FLAGS_"):
+                name = name[len("FLAGS_"):]
+            if name not in _registry:
+                raise KeyError(f"unknown flag: {name}")
+            _registry[name]["value"] = _coerce(value, _registry[name]["default"])
+
+
+# --- core flags (subset of the reference's 59, TPU-relevant ones) -----------
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debug)")
+define_flag("benchmark", False, "synchronize and time each op")
+define_flag("eager_op_jit", False, "jit-cache eager per-op execution")
+define_flag("use_bf16_matmul", True, "prefer bf16 inputs on MXU matmuls")
+define_flag("seed", 0, "global random seed (0 = nondeterministic)")
+define_flag("tpu_interpret_pallas", False, "run pallas kernels in interpret mode")
+define_flag("log_level", 0, "framework VLOG-style verbosity")

@@ -1,0 +1,136 @@
+"""Random state management.
+
+TPU-native analog of the reference ``Generator`` (paddle/fluid/framework/generator.h:119,
+paddle/phi/core/generator.h:23) and the TP-aware ``RNGStatesTracker``
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:32).
+
+Design: eager mode keeps one stateful PRNG key per named stream and splits a
+fresh subkey per draw (counter-based, like the reference's per-generator
+engines).  Under jit the same API takes explicit keys.  The tracker gives
+distinct deterministic streams per mesh axis (e.g. identical dropout across a
+TP group via 'global_seed', distinct per-rank dropout via 'local_seed').
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+__all__ = [
+    "seed",
+    "split_key",
+    "current_key",
+    "get_rng_state",
+    "set_rng_state",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+]
+
+
+class _Stream:
+    __slots__ = ("key", "counter")
+
+    def __init__(self, seed_val: int):
+        self.key = jax.random.key(seed_val)
+        self.counter = 0
+
+
+class _RandomState(threading.local):
+    def __init__(self):
+        self.streams: dict[str, _Stream] = {"default": _Stream(np.random.randint(0, 2**31 - 1))}
+        self.active = "default"
+        self.override = None  # (base_key, counter) — jit-safe traced stream
+
+
+_state = _RandomState()
+
+
+@contextlib.contextmanager
+def key_stream(base_key):
+    """Make subsequent ``split_key()`` calls derive deterministically from
+    ``base_key`` (which may be a traced value).  This is how stateful eager
+    RNG (dropout etc.) stays functional under ``jit``: the train step takes an
+    explicit key and installs it around the forward pass."""
+    prev = _state.override
+    _state.override = [base_key, 0]
+    try:
+        yield
+    finally:
+        _state.override = prev
+
+
+def seed(value: int, stream: str = "default"):
+    """Seed a named stream (default stream by default). Parity: paddle.seed."""
+    _state.streams[stream] = _Stream(int(value))
+    return value
+
+
+def split_key(stream: str | None = None):
+    """Draw a fresh subkey from the active (or named) stateful stream."""
+    if _state.override is not None:
+        base, counter = _state.override
+        _state.override[1] = counter + 1
+        return jax.random.fold_in(base, counter)
+    name = stream or _state.active
+    if name not in _state.streams:
+        _state.streams[name] = _Stream(np.random.randint(0, 2**31 - 1))
+    s = _state.streams[name]
+    s.key, sub = jax.random.split(s.key)
+    s.counter += 1
+    return sub
+
+
+def current_key(stream: str = "default"):
+    return _state.streams[stream].key
+
+
+def get_rng_state():
+    return {name: (s.key, s.counter) for name, s in _state.streams.items()}
+
+
+def set_rng_state(snapshot):
+    for name, (key, counter) in snapshot.items():
+        s = _Stream(0)
+        s.key, s.counter = key, counter
+        _state.streams[name] = s
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor-parallel determinism.
+
+    ``add('local_seed', base + tp_rank)`` / ``add('global_seed', base)``;
+    ``with tracker.rng_state('local_seed'): dropout(...)`` draws from that
+    stream so TP ranks agree (global) or differ (local) deterministically.
+    """
+
+    def __init__(self):
+        self.seeds = set()
+
+    def add(self, name: str, seed_val: int):
+        if seed_val in self.seeds:
+            raise ValueError(f"seed {seed_val} already added to tracker")
+        self.seeds.add(seed_val)
+        seed(seed_val, stream=name)
+
+    def reset(self):
+        self.seeds = set()
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in _state.streams:
+            raise KeyError(f"rng stream '{name}' not registered in tracker")
+        prev = _state.active
+        _state.active = name
+        try:
+            yield
+        finally:
+            _state.active = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

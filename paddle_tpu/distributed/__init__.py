@@ -1,0 +1,33 @@
+"""paddle_tpu.distributed — Fleet-style distributed stack over jax.sharding.
+
+Reference ⇄ TPU mapping (SURVEY.md §2.3): NCCL rings → XLA collectives over
+ICI emitted by pjit/shard_map; ProcessGroups → mesh axes; TCPStore rendezvous
+→ jax coordination service (jax.distributed.initialize); Heter two-tier →
+ICI-vs-DCN hierarchical meshes.
+"""
+from .env import (  # noqa: F401
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    split,
+    ReduceOp,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import fleet  # noqa: F401
+from .parallel import DataParallel  # noqa: F401

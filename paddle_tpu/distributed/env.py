@@ -1,0 +1,73 @@
+"""Distributed environment (parity: python/paddle/distributed/parallel.py:91
+``init_parallel_env`` + fluid/dygraph/parallel.py ``ParallelEnv``).
+
+TPU model: single-controller SPMD per host.  ``rank``/``world_size`` describe
+*processes* (hosts), as in jax.distributed; device-level parallelism lives in
+the mesh (topology.py).  Rendezvous: jax coordination service replaces the
+reference's TCPStore (distributed/store/tcp_store.cc).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "ParallelEnv"]
+
+_initialized = [False]
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Initialize multi-host env.  Reads PADDLE_*/standard env when args are
+    absent; single-host (the common axon/test case) is a no-op that still
+    marks the env ready, mirroring init_parallel_env on one card."""
+    if _initialized[0]:
+        return ParallelEnv()
+    coord = coordinator_address or os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Parity shim for paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()

@@ -1,0 +1,16 @@
+"""Fleet facade (parity: python/paddle/distributed/fleet/base/fleet_base.py:139).
+
+``fleet.init(strategy)`` builds the HybridCommunicateGroup/Mesh from the
+DistributedStrategy degrees; ``distributed_model``/``distributed_optimizer``
+wrap model+optimizer per parallel mode, and the hybrid Engine (engine.py)
+compiles the whole train step with pjit over the mesh.
+"""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet,
+    distributed_model,
+    distributed_optimizer,
+    fleet,
+    get_hybrid_communicate_group,
+    init,
+)

@@ -1,0 +1,57 @@
+"""DistributedStrategy (parity: framework/distributed_strategy.proto +
+python/paddle/distributed/fleet/base/distributed_strategy.py).
+
+A plain config object (the protobuf is an implementation detail of the
+reference); the fields mirror the proto's sub-messages that are meaningful
+on TPU.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid degrees (proto :37-55)
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        # amp (proto :60-70)
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_bf16": True,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "policy": "full"}
+        # sharding / ZeRO
+        self.sharding = False
+        self.sharding_configs = {"stage": 2, "offload": False}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        # gradient merge / accumulation
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # comm-efficiency knobs kept for parity (no-ops where XLA owns fusion)
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.localsgd = False
+        self.dgc = False
+        self.lars = False
+        self.lamb = False
+        self.find_unused_parameters = False
+        # sequence/context parallel (new first-class capability)
+        self.sep_configs = {"mode": "ring"}  # ring | ulysses
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()}
+        return f"DistributedStrategy({fields})"

@@ -1,0 +1,101 @@
+"""Fleet facade (parity: fleet_base.py:139 ``Fleet``; init:206,
+distributed_optimizer:880, distributed_model:937).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..topology import HybridCommunicateGroup
+from .distributed_strategy import DistributedStrategy
+
+__all__ = ["Fleet", "fleet", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group"]
+
+_hcg: list = [None]
+_strategy: list = [None]
+
+
+def get_hybrid_communicate_group():
+    return _hcg[0]
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        strategy = strategy or DistributedStrategy()
+        cfg = strategy.hybrid_configs
+        n = jax.device_count()
+        degrees = (cfg["dp_degree"] * cfg["mp_degree"] * cfg["pp_degree"] *
+                   cfg["sharding_degree"] * cfg.get("sep_degree", 1))
+        if degrees not in (1, n):
+            # auto-fill dp to absorb remaining devices (reference: dp fills)
+            rest = n // max(cfg["mp_degree"] * cfg["pp_degree"] *
+                            cfg["sharding_degree"] * cfg.get("sep_degree", 1), 1)
+            cfg["dp_degree"] = max(rest, 1)
+        _hcg[0] = HybridCommunicateGroup(
+            dp_degree=cfg["dp_degree"], mp_degree=cfg["mp_degree"],
+            pp_degree=cfg["pp_degree"], sharding_degree=cfg["sharding_degree"],
+            sep_degree=cfg.get("sep_degree", 1))
+        _strategy[0] = strategy
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self):
+        return _hcg[0]
+
+    @property
+    def strategy(self):
+        return _strategy[0]
+
+    def distributed_model(self, model):
+        """Wrap per parallel mode (parity: fleet_base.py:1043-1069).
+
+        On TPU the jit Engine handles dp/sharding/mp via shardings, so most
+        wrapping is metadata; PP wraps into the pipeline engine.
+        """
+        hcg = _hcg[0]
+        mode = hcg.get_parallel_mode()
+        if mode == "pipeline_parallel":
+            from ..pipeline import PipelineParallel
+
+            return PipelineParallel(model, hcg, _strategy[0])
+        if mode == "data_parallel":
+            from ..parallel import DataParallel
+
+            return DataParallel(model, group=hcg.get_data_parallel_group())
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        return HybridParallelOptimizer(optimizer, _hcg[0],
+                                       strategy or _strategy[0])
+
+    # rank helpers -----------------------------------------------------
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def barrier_worker(self):
+        pass
+
+    # checkpoint passthroughs -----------------------------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None):
+        raise NotImplementedError("use paddle_tpu.distributed.checkpoint")
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer

@@ -1,0 +1,56 @@
+"""DataParallel wrapper.
+
+Parity: python/paddle/fluid/dygraph/parallel.py:413 ``DataParallel`` + the
+bucketed Reducer (imperative/reducer.cc:126, collective/reducer.cc EagerReducer).
+
+TPU-native stance: on the jit path, DP gradient sync is a sharding annotation
+(grads become psum'd automatically by GSPMD when the batch axis is sharded) —
+there is nothing to bucket because XLA fuses collectives.  This wrapper keeps
+API parity for eager code: forward delegates to the wrapped layer, and
+``apply_collective_grads`` (the Reducer analog) all-reduces .grad over the dp
+group explicitly — used when running one process per chip (multi-host eager).
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .collective import ReduceOp, all_reduce
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def apply_collective_grads(self):
+        """Reducer analog: average grads across the dp group."""
+        n = self.group.nranks if self.group else 1
+        for p in self._layers.parameters():
+            if p.grad is not None and not p.stop_gradient:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)
+                if n > 1:
+                    p.grad.data = p.grad.data / n
+
+    # delegation so DataParallel is transparent
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss

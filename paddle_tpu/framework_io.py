@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load parity (python/paddle/framework/io.py:568,784).
+
+Pickles nested state structures with tensors converted to numpy (protocol 4,
+like the reference's >4GB-safe path).  Works for Layer.state_dict(),
+Optimizer.state_dict(), and arbitrary nested containers.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.data))
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_numpy_tree(v) for v in obj)
+    return obj
+
+
+def _from_numpy_tree(obj):
+    if isinstance(obj, _TensorPayload):
+        return Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_numpy_tree(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    def __init__(self, array):
+        self.array = array
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        def unwrap(o):
+            if isinstance(o, _TensorPayload):
+                return o.array
+            if isinstance(o, dict):
+                return {k: unwrap(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return type(o)(unwrap(v) for v in o)
+            return o
+
+        return unwrap(obj)
+    return _from_numpy_tree(obj)

@@ -1,0 +1,26 @@
+"""Data pipeline (parity: python/paddle/io + fluid/dataloader/).
+
+Dataset/Sampler/DataLoader with multi-worker prefetch.  The reference's
+C++ data path (framework/data_feed.*, operators/reader) exists to feed GPUs
+from CPU threads; on TPU the analog is background host threads producing
+numpy batches that jax transfers to device asynchronously.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,137 @@
+"""DataLoader (parity: python/paddle/fluid/reader.py:273 DataLoader +
+fluid/dataloader/dataloader_iter.py:341 multiprocess iter).
+
+Design: worker *threads* (not processes) with a bounded prefetch queue.
+The producers run numpy/PIL code while the main thread feeds the device —
+on TPU the overlap that matters is host-compute vs device-step, and jax
+dispatch already makes device work async.  (The reference needs processes
+because of Python-heavy decode + CUDA contexts; start with threads, keep the
+API so a process pool can slot in.)
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return tuple(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.data) for s in batch]))
+    arr = np.stack([np.asarray(s) for s in batch])
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return Tensor(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.iterable_mode = isinstance(dataset, IterableDataset)
+        if self.iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self.iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_batches(self):
+        if self.iterable_mode:
+            batch = []
+            for item in self.dataset:
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            yield from self._iter_batches()
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        """Bounded-queue prefetch: worker threads pull batch indices, build
+        batches, push to the queue in submission order."""
+        if self.iterable_mode:
+            # single producer thread for iterable datasets
+            q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+            STOP = object()
+
+            def produce():
+                try:
+                    for b in self._iter_batches():
+                        q.put(b)
+                finally:
+                    q.put(STOP)
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            while True:
+                item = q.get()
+                if item is STOP:
+                    break
+                yield item
+            return
+
+        index_q: queue.Queue = queue.Queue()
+        all_batches = list(self.batch_sampler)
+        results: dict[int, object] = {}
+        results_lock = threading.Condition()
+        for i, b in enumerate(all_batches):
+            index_q.put((i, b))
+
+        def worker():
+            while True:
+                try:
+                    i, indices = index_q.get_nowait()
+                except queue.Empty:
+                    return
+                batch = self.collate_fn([self.dataset[j] for j in indices])
+                with results_lock:
+                    results[i] = batch
+                    results_lock.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        for i in range(len(all_batches)):
+            with results_lock:
+                while i not in results:
+                    results_lock.wait()
+                yield results.pop(i)

@@ -1,0 +1,143 @@
+"""jit — trace-and-compile ("static graph") path.
+
+This replaces the reference's entire static stack: dy2static AST transpiler
+(fluid/dygraph/dygraph_to_static/program_translator.py:775), ProgramDesc
+capture, and the executors (classic Executor, ParallelExecutor,
+InterpreterCore — framework/new_executor/interpretercore.cc:114).  On TPU the
+compiled program *is* the executor: ``to_static`` traces the Layer/function
+once per input signature into an XLA executable via jax.jit; instruction
+scheduling, stream assignment, memory planning and GC — the jobs of
+InterpreterCore/StreamAnalyzer — are all owned by XLA/PJRT.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["to_static", "TracedLayer", "save", "load", "not_to_static"]
+
+
+class TracedLayer:
+    """A Layer (or function) compiled to an XLA executable per input shape.
+
+    The pure function closed over is ``f(params, buffers, *array_inputs)``;
+    parameter storage is swapped in via Layer.swap_state so the user's eager
+    Layer code runs unmodified under tracing — the analog of the reference's
+    partial_program.py running a converted program in dygraph.
+    """
+
+    def __init__(self, layer_or_fn, donate_params=False):
+        self.target = layer_or_fn
+        self.is_layer = isinstance(layer_or_fn, Layer)
+        self._compiled = None
+
+        if self.is_layer:
+            layer = layer_or_fn
+
+            def pure(params, buffers, *inputs):
+                with layer.swap_state(params, buffers):
+                    out = layer.forward(*[Tensor(x) for x in inputs])
+                return jax.tree_util.tree_map(
+                    lambda t: t.data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            self._pure = pure
+            self._compiled = jax.jit(pure)
+        else:
+            fn = layer_or_fn
+
+            def pure(*inputs):
+                from ..core.autograd import no_grad
+
+                with no_grad():
+                    out = fn(*[Tensor(x) if isinstance(x, jax.Array) else x
+                               for x in inputs])
+                return jax.tree_util.tree_map(
+                    lambda t: t.data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            self._pure = pure
+            self._compiled = jax.jit(pure)
+
+    def _unwrap(self, args):
+        return tuple(a.data if isinstance(a, Tensor) else a for a in args)
+
+    def __call__(self, *args):
+        arr_args = self._unwrap(args)
+        if self.is_layer:
+            params, buffers = self.target.raw_state()
+            out = self._compiled(params, buffers, *arr_args)
+        else:
+            out = self._compiled(*arr_args)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    # introspection / export -------------------------------------------------
+    def lower(self, *args):
+        arr_args = self._unwrap(args)
+        if self.is_layer:
+            params, buffers = self.target.raw_state()
+            return self._compiled.lower(params, buffers, *arr_args)
+        return self._compiled.lower(*arr_args)
+
+    def stablehlo(self, *args):
+        """Serialized program text — the framework.proto/ProgramDesc analog."""
+        return self.lower(*args).as_text()
+
+    def forward(self, *args):
+        return self(*args)
+
+
+def to_static(layer_or_fn=None, input_spec=None, **kwargs):
+    """Decorator/wrapper parity: paddle.jit.to_static."""
+    if layer_or_fn is None:
+        return functools.partial(to_static, input_spec=input_spec, **kwargs)
+    traced = TracedLayer(layer_or_fn)
+    if isinstance(layer_or_fn, Layer):
+        return traced
+    functools.update_wrapper(traced, layer_or_fn)
+    return traced
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def save(layer, path, input_spec=None, example_inputs=None):
+    """paddle.jit.save parity: persist params + serialized StableHLO program.
+
+    Artifact layout: ``{path}.pdiparams.npz`` (weights) + ``{path}.stablehlo``
+    (program text, requires example_inputs) + ``{path}.pdmodel.json`` (meta).
+    """
+    import json
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    arrays = {k: np.asarray(v.data) for k, v in state.items()}
+    np.savez(path + ".pdiparams.npz", **arrays)
+    meta = {"class": type(layer).__name__, "keys": list(arrays)}
+    if example_inputs is not None:
+        traced = layer if isinstance(layer, TracedLayer) else TracedLayer(layer)
+        hlo = traced.stablehlo(*example_inputs)
+        with open(path + ".stablehlo", "w") as f:
+            f.write(hlo)
+        meta["has_program"] = True
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump(meta, f)
+
+
+def load(path, layer=None):
+    """paddle.jit.load parity: restore weights into ``layer`` (and return a
+    TracedLayer over it)."""
+    data = np.load(path + ".pdiparams.npz")
+    state = {k: Tensor(np.asarray(data[k])) for k in data.files}
+    if layer is not None:
+        layer.set_state_dict(state)
+        return TracedLayer(layer)
+    return state

@@ -1,0 +1,378 @@
+"""Layer base class.
+
+TPU-native analog of the reference ``paddle.nn.Layer``
+(python/paddle/fluid/dygraph/layers.py): parameter/buffer/sublayer registry,
+state_dict round-trips, train/eval mode, hooks — plus a *functional bridge*
+(``raw_state`` / ``swap_state``) that lets jax transforms (jit/grad/pjit) run
+a Layer as a pure function over its parameter pytree.  That bridge is the
+whole trace-and-compile story: it is what replaces the reference's
+ProgramDesc capture.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+
+import numpy as np
+
+from ...core.dtype import get_default_dtype
+from ...core.tensor import Parameter, Tensor
+from ..initializer import Constant, Initializer, XavierUniform
+
+__all__ = ["Layer", "Sequential", "LayerList", "ParameterList", "Identity"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        self.training = True
+        self._dtype = dtype or get_default_dtype()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or type(self).__name__.lower()
+
+    # -------------------------------------------------------------- registry
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                del params[name]
+            if buffers is not None and name in buffers:
+                # keep registry in sync when a registered buffer is reassigned
+                if value is None or isinstance(value, Tensor):
+                    persistable = (buffers[name].persistable
+                                   if buffers[name] is not None else True)
+                    if value is not None:
+                        value.persistable = persistable
+                    buffers[name] = value
+                else:
+                    del buffers[name]
+            object.__setattr__(self, name, value)
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[name] = tensor
+        object.__setattr__(self, name, tensor)
+
+    def register_parameter(self, name, param):
+        self._parameters[name] = param
+        object.__setattr__(self, name, param)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        object.__setattr__(self, str(name), sublayer)
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self.register_parameter(name, parameter)
+        return parameter
+
+    def create_parameter(self, shape, dtype=None, is_bias=False,
+                         default_initializer=None, attr=None):
+        """Parity: fluid/dygraph/layers.py ``create_parameter`` (via
+        LayerHelper); initializer defaults mirror the reference (Xavier for
+        weights, zeros for bias)."""
+        dtype = dtype or self._dtype
+        init = default_initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        if not isinstance(init, Initializer) and callable(init):
+            data = init(shape, dtype)
+        else:
+            data = init(shape, dtype)
+        p = Parameter(data)
+        if attr is not None:
+            if getattr(attr, "learning_rate", None) is not None:
+                p.optimize_attr["learning_rate"] = attr.learning_rate
+            if getattr(attr, "trainable", True) is False:
+                p.stop_gradient = True
+                p.trainable = False
+            if getattr(attr, "name", None):
+                p.name = attr.name
+        return p
+
+    # ------------------------------------------------------------- traversal
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{name}.{pname}" if name else pname
+                yield full, p
+            if not include_sublayers:
+                break
+
+    def named_buffers(self, prefix=""):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = f"{name}.{bname}" if name else bname
+                yield full, b
+
+    def buffers(self):
+        return [b for _, b in self.named_buffers()]
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def children(self):
+        return list(self._sub_layers.values())
+
+    def named_children(self):
+        return list(self._sub_layers.items())
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, include_sublayers=True, structured_name_prefix=""):
+        out = OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            if b is not None and b.persistable:
+                out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value.data if isinstance(value, Tensor) else np.asarray(value)
+                target.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------ run modes
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    def to(self, device=None, dtype=None):
+        from ...core.place import Place
+
+        for t in list(self.parameters()) + [b for b in self.buffers() if b is not None]:
+            if dtype is not None:
+                t.data = t.data.astype(dtype)
+            if device is not None:
+                import jax
+
+                place = device if isinstance(device, Place) else None
+                if place is None:
+                    from ...core.place import set_device
+
+                    place = set_device(device)
+                t.data = jax.device_put(t.data, place.jax_device())
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # ----------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ------------------------------------------------------------------ call
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    # ------------------------------------------- functional bridge (jit/pjit)
+    def raw_state(self):
+        """Return ``(params, buffers)`` as dicts of raw jax arrays — the pure
+        pytree a jax transform closes over."""
+        params = {k: v.data for k, v in self.named_parameters()}
+        buffers = {k: v.data for k, v in self.named_buffers() if v is not None}
+        return params, buffers
+
+    @contextlib.contextmanager
+    def swap_state(self, params=None, buffers=None):
+        """Temporarily replace parameter/buffer storage with the given arrays
+        (possibly tracers).  Inside the context the Layer runs as a pure
+        function of those arrays; autograd taping is disabled."""
+        from ...core.autograd import no_grad
+
+        named_p = dict(self.named_parameters())
+        named_b = {k: v for k, v in self.named_buffers() if v is not None}
+        saved_p = {k: t.data for k, t in named_p.items()}
+        saved_b = {k: t.data for k, t in named_b.items()}
+        try:
+            if params:
+                for k, arr in params.items():
+                    named_p[k].data = arr
+            if buffers:
+                for k, arr in buffers.items():
+                    if k in named_b:
+                        named_b[k].data = arr
+            with no_grad():
+                yield self
+        finally:
+            for k, arr in saved_p.items():
+                named_p[k].data = arr
+            for k, arr in saved_b.items():
+                named_b[k].data = arr
+
+    def __repr__(self):
+        extra = []
+        for name, layer in self._sub_layers.items():
+            extra.append(f"  ({name}): {type(layer).__name__}")
+        inner = "\n".join(extra)
+        return f"{type(self).__name__}(\n{inner}\n)" if inner else f"{type(self).__name__}()"
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, registry):
+        self.registry = registry
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+
+    def remove(self):
+        self.registry.pop(self.id, None)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx % len(self._sub_layers))]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for i, p in enumerate(parameters):
+                self.register_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.register_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x

@@ -1,0 +1,173 @@
+"""Recurrent layers (parity: python/paddle/nn/layer/rnn.py).
+
+TPU note: recurrences are expressed as ``lax.scan`` in the pure path so XLA
+compiles one unrolled-free loop; the eager path loops in Python over the
+same cell step (fine for short sequences / tests).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import ops
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer, LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class _CellBase(Layer):
+    def _uniform_init(self, hidden_size):
+        import math
+
+        k = 1.0 / math.sqrt(hidden_size)
+        return Uniform(-k, k)
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh"):
+        super().__init__()
+        init = self._uniform_init(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], is_bias=True, default_initializer=init)
+        self.activation = getattr(ops, activation)
+
+    def forward(self, x, h=None):
+        if h is None:
+            h = ops.zeros([x.shape[0], self.hidden_size], dtype=x.dtype)
+        pre = (ops.matmul(x, ops.t(self.weight_ih)) + self.bias_ih +
+               ops.matmul(h, ops.t(self.weight_hh)) + self.bias_hh)
+        h_new = self.activation(pre)
+        return h_new, h_new
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        init = self._uniform_init(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True, default_initializer=init)
+
+    def forward(self, x, state=None):
+        if state is None:
+            z = ops.zeros([x.shape[0], self.hidden_size], dtype=x.dtype)
+            state = (z, z)
+        h, c = state
+        gates = (ops.matmul(x, ops.t(self.weight_ih)) + self.bias_ih +
+                 ops.matmul(h, ops.t(self.weight_hh)) + self.bias_hh)
+        i, f, g, o = ops.split(gates, 4, axis=-1)
+        i, f, o = ops.sigmoid(i), ops.sigmoid(f), ops.sigmoid(o)
+        g = ops.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * ops.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        init = self._uniform_init(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True, default_initializer=init)
+
+    def forward(self, x, h=None):
+        if h is None:
+            h = ops.zeros([x.shape[0], self.hidden_size], dtype=x.dtype)
+        gi = ops.matmul(x, ops.t(self.weight_ih)) + self.bias_ih
+        gh = ops.matmul(h, ops.t(self.weight_hh)) + self.bias_hh
+        i_r, i_z, i_n = ops.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = ops.split(gh, 3, axis=-1)
+        r = ops.sigmoid(i_r + h_r)
+        z = ops.sigmoid(i_z + h_z)
+        n = ops.tanh(i_n + r * h_n)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Runs a cell over time (parity: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        # inputs: [B, T, F] (batch-major) or [T, B, F]
+        if not self.time_major:
+            inputs = ops.transpose(inputs, [1, 0, 2])
+        T = inputs.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outputs = []
+        state = initial_states
+        for t in steps:
+            out, state = self.cell(inputs[t], state)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = ops.stack(outputs, axis=0)
+        if not self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, state
+
+
+class _MultiLayerRNN(Layer):
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0):
+        super().__init__()
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        layers = []
+        num_dir = 2 if self.bidirectional else 1
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * num_dir
+            layers.append(RNN(self.CELL(in_sz, hidden_size), time_major=time_major))
+            if self.bidirectional:
+                layers.append(RNN(self.CELL(in_sz, hidden_size), is_reverse=True,
+                                  time_major=time_major))
+        self.layers = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None):
+        out = inputs
+        num_dir = 2 if self.bidirectional else 1
+        final_states = []
+        for i in range(self.num_layers):
+            if self.bidirectional:
+                fwd, sf = self.layers[2 * i](out)
+                bwd, sb = self.layers[2 * i + 1](out)
+                out = ops.concat([fwd, bwd], axis=-1)
+                final_states.extend([sf, sb])
+            else:
+                out, s = self.layers[i](out)
+                final_states.append(s)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = ops.dropout(out, p=self.dropout, training=self.training)
+        return out, final_states
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

@@ -1,0 +1,22 @@
+"""Op corpus.
+
+TPU-native replacement for the reference's operator layers
+(paddle/fluid/operators + paddle/phi/kernels + the generated
+paddle::experimental C++ API from python/paddle/utils/code_gen/api.yaml).
+Every op here is a pure jax function registered through
+core.dispatch.register_op, giving it the eager autograd wrapper and a
+registry entry for the OpTest conformance harness.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .nn_ops import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from ..core.dispatch import OP_REGISTRY, get_op, list_ops  # noqa: F401

@@ -1,0 +1,147 @@
+"""Activation ops (parity: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+
+@register_op("relu")
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@register_op("relu6")
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+@register_op("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@register_op("prelu")
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+@register_op("elu")
+def elu(x, alpha=1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("celu")
+def celu(x, alpha=1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+@register_op("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_op("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@register_op("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+@register_op("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0, 1)
+
+
+@register_op("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@register_op("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+@register_op("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0))
+
+
+@register_op("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0)
+
+
+@register_op("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("maxout")
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(tuple(shape)), axis=axis + 1)
+
+
+@register_op("softmax")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softsign")
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+@register_op("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_op("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None):
+    if key is None:
+        from ..core.random import split_key
+
+        key = split_key()
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        # straight-through: hard one-hot forward, soft gradient backward
+        y_hard = jax.nn.one_hot(
+            jnp.argmax(y, axis=axis), y.shape[axis], dtype=y.dtype, axis=axis
+        )
+        y = y + jax.lax.stop_gradient(y_hard - y)
+    return y

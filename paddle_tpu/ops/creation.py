@@ -1,0 +1,123 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return get_default_dtype() if default_float else jnp.int64
+    return convert_dtype(dtype)
+
+
+@register_op("zeros", differentiable=False)
+def zeros(shape, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_dt(dtype))
+
+
+@register_op("ones", differentiable=False)
+def ones(shape, dtype=None):
+    return jnp.ones(tuple(shape), dtype=_dt(dtype))
+
+
+@register_op("full", differentiable=False)
+def full(shape, fill_value, dtype=None):
+    return jnp.full(tuple(shape), fill_value, dtype=_dt(dtype))
+
+
+@register_op("zeros_like")
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+@register_op("ones_like")
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype))
+
+
+@register_op("full_like")
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
+
+
+@register_op("arange", differentiable=False)
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+@register_op("linspace", differentiable=False)
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+@register_op("eye", differentiable=False)
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+@register_op("diag")
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+@register_op("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@register_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_op("meshgrid")
+def meshgrid(*args):
+    return tuple(jnp.meshgrid(*args, indexing="ij"))
+
+
+@register_op("assign")
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_op("clone")
+def clone(x):
+    return jnp.asarray(x)
+
+
+@register_op("empty", differentiable=False)
+def empty(shape, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_dt(dtype))
+
+
+@register_op("empty_like", differentiable=False)
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+@register_op("complex")
+def complex(real, imag):  # noqa: A001
+    return jax_lax_complex(real, imag)
+
+
+def jax_lax_complex(real, imag):
+    import jax.lax as lax
+
+    return lax.complex(real, imag)
+
+
+def tensor_ctor(data, dtype=None, place=None, stop_gradient=True):
+    from ..core.tensor import to_tensor
+
+    return to_tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,224 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py).
+
+Matmuls go straight to the MXU via lax.dot_general; ``preferred_element_type``
+keeps accumulation in fp32 when operands are bf16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+
+def mxu_precision(*arrays):
+    """MXU precision policy: f32 operands get true-f32 accuracy (multi-pass);
+    bf16 operands use the native bf16-multiply/f32-accumulate path, which is
+    the fast mode this framework's AMP targets."""
+    for a in arrays:
+        if hasattr(a, "dtype") and a.dtype == jnp.float32:
+            return jax.lax.Precision.HIGHEST
+    return None
+
+
+@register_op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    pet = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, y, preferred_element_type=pet,
+                     precision=mxu_precision(x, y))
+    return out.astype(x.dtype) if pet is not None else out
+
+
+@register_op("mm")
+def mm(x, y):
+    return jnp.matmul(x, y, precision=mxu_precision(x, y))
+
+
+@register_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=mxu_precision(x, y))
+
+
+@register_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_op("cross")
+def cross(x, y, axis=None):
+    if axis is None:
+        axis = -1
+        for i, s in enumerate(x.shape):
+            if s == 3:
+                axis = i
+                break
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op("t")
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register_op("norm")
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (tuple, list)) else None,
+                               axis=tuple(axis) if isinstance(axis, list) else axis,
+                               keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@register_op("dist")
+def dist(x, y, p=2.0):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@register_op("trace_op")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op("pinv")
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+@register_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdet")
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+@register_op("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@register_op("qr")
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@register_op("svd")
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@register_op("eigh")
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op("matrix_rank", differentiable=False)
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register_op("lstsq")
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("multi_dot")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+@register_op("einsum")
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands,
+                      precision=mxu_precision(*operands))
+
+
+@register_op("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec, precision=mxu_precision(x, vec))
+
+
+@register_op("histogram", differentiable=False)
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+    if lo is None:
+        lo = jnp.min(x)
+        hi = jnp.max(x)
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+@register_op("bincount", differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)

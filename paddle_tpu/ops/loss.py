@@ -1,0 +1,175 @@
+"""Loss ops (parity: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("cross_entropy")
+def cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                  reduction="mean", axis=-1, weight=None, use_softmax=True):
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+    if soft_label:
+        if weight is not None:
+            logp = logp * weight  # per-class weights broadcast over the axis
+        loss = -jnp.sum(label * logp, axis=axis)
+    else:
+        label = label.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.maximum(label, 0), axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis)
+        valid = label != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if weight is not None:
+            w = jnp.take(weight, jnp.maximum(label, 0))
+            loss = loss * jnp.where(valid, w, 0.0)
+        if reduction == "mean":
+            if weight is not None:
+                denom = jnp.maximum(jnp.sum(jnp.where(valid, jnp.take(weight, jnp.maximum(label, 0)), 0.0)), 1e-12)
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.maximum(lbl, 0), axis), axis=axis)
+        loss = -picked
+        loss = jnp.where(jnp.expand_dims(lbl, axis) != ignore_index, loss, 0.0)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+@register_op("nll_loss")
+def nll_loss(log_prob, label, weight=None, ignore_index=-100, reduction="mean"):
+    picked = jnp.take_along_axis(
+        log_prob, jnp.expand_dims(jnp.maximum(label, 0), -1), axis=-1)
+    loss = -jnp.squeeze(picked, -1)
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        loss = loss * jnp.take(weight, jnp.maximum(label, 0))
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@register_op("mse_loss")
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@register_op("l1_loss")
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@register_op("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@register_op("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    max_val = jnp.maximum(-logit, 0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@register_op("kl_div")
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@register_op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(-label * (input - other) + margin, 0)
+    return _reduce(loss, reduction)
+
+
+@register_op("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    loss = jnp.where(label == 1, input, jnp.maximum(margin - input, 0))
+    return _reduce(loss, reduction)
+
+
+@register_op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.maximum(jnp.sum(x1 * x1, axis=axis), eps * eps))
+    n2 = jnp.sqrt(jnp.maximum(jnp.sum(x2 * x2, axis=axis), eps * eps))
+    return dot / (n1 * n2)
+
+
+@register_op("square_error_cost")
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + jnp.maximum(-logit, 0)
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@register_op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k

@@ -1,0 +1,323 @@
+"""Shape/layout manipulation ops (parity: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.dtype import convert_dtype
+
+
+@register_op("cast")
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+@register_op("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+
+@register_op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = list(x.shape)
+    merged = 1
+    for s in shape[start : stop + 1]:
+        merged *= s
+    new_shape = shape[:start] + [merged] + shape[stop + 1 :]
+    return jnp.reshape(x, tuple(new_shape))
+
+
+@register_op("transpose")
+def transpose(x, perm):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+@register_op("transpose_last2")
+def transpose_last2(x):
+    if x.ndim < 2:
+        return x
+    perm = list(range(x.ndim))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    return jnp.transpose(x, axes=perm)
+
+
+@register_op("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes")
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in sorted(axis):
+            out = jnp.expand_dims(out, a)
+        return out
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axes = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+@register_op("concat")
+def concat(xs, axis=0):
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+@register_op("stack")
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+@register_op("unstack")
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+@register_op("split")
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    # paddle allows one -1 entry
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets, acc = [], 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+@register_op("chunk")
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.split(x, chunks, axis=axis))
+
+
+@register_op("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+@register_op("expand")
+def expand(x, shape):
+    shape = list(shape)
+    # -1 means keep this dim
+    x_shape = [1] * (len(shape) - x.ndim) + list(x.shape)
+    out_shape = tuple(
+        x_shape[i] if s == -1 else int(s) for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x.reshape(tuple(x_shape)), out_shape)
+
+
+@register_op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_op("flip")
+def flip(x, axis):
+    return jnp.flip(x, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("gather")
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_op("take_along_axis")
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@register_op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(jnp.asarray(values, dtype=x.dtype), indices.shape)
+    # build scatter indices from take_along_axis semantics
+    it = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    full_idx = list(it)
+    full_idx[axis % x.ndim] = indices
+    flat_idx = tuple(full_idx)
+    if reduce == "assign":
+        return x.at[flat_idx].set(values)
+    if reduce == "add":
+        return x.at[flat_idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[flat_idx].multiply(values)
+    raise ValueError(f"unknown reduce: {reduce}")
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@register_op("masked_select")
+def masked_select(x, mask):
+    # dynamic output shape — only usable in eager mode, not under jit
+    import numpy as np
+
+    xn = np.asarray(x)
+    mn = np.asarray(mask)
+    return jnp.asarray(xn[np.broadcast_to(mn, xn.shape)])
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+@register_op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pad applies to last len(pad)//2 spatial dims,
+        # ordered from the last dim backwards in (before, after) pairs
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * x.ndim
+        for i in range(n_spatial):
+            dim = x.ndim - 1 - i
+            cfg[dim] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@register_op("getitem")
+def getitem(x, idx):
+    if isinstance(idx, (list, tuple)):
+        idx = tuple(
+            jnp.asarray(i) if hasattr(i, "__jax_array__") else i for i in idx
+        )
+    return x[idx]
+
+
+@register_op("slice")
+def slice(x, axes, starts, ends):  # noqa: A001
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = jnp.s_[st:en]
+    return x[tuple(idx)]
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[st:en:sd]
+    return x[tuple(idx)]
+
+
+@register_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("unbind")
+def unbind(x, axis=0):
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+@register_op("as_real", differentiable=False)
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op("as_complex", differentiable=False)
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@register_op("unique", differentiable=False)
+def unique(x):
+    # dynamic shape: eager-only
+    import numpy as np
+
+    return jnp.asarray(np.unique(np.asarray(x)))
+
+
+@register_op("nonzero", differentiable=False)
+def nonzero(x):
+    import numpy as np
+
+    nz = np.nonzero(np.asarray(x))
+    return jnp.stack([jnp.asarray(i) for i in nz], axis=1)
+
+
+@register_op("shard_index", differentiable=False)
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = (shard_id + 1) * shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)

@@ -1,0 +1,465 @@
+"""Elementwise & scalar math ops (parity: python/paddle/tensor/math.py).
+
+Each op is a pure jax function; XLA fuses chains of these into single
+HBM-bandwidth-bound kernels, so there is no per-op fusion work to do here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+# ----------------------------------------------------------------- binary
+
+
+@register_op("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@register_op("subtract")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register_op("multiply")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register_op("divide")
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@register_op("floor_divide")
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@register_op("remainder")
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+@register_op("pow")
+def pow(x, y):  # noqa: A001
+    return jnp.power(x, y)
+
+
+@register_op("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_op("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register_op("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@register_op("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@register_op("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@register_op("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+# ------------------------------------------------------------------ unary
+
+
+@register_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op("abs")
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+@register_op("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@register_op("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register_op("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register_op("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register_op("log")
+def log(x):
+    return jnp.log(x)
+
+
+@register_op("log2")
+def log2(x):
+    return jnp.log2(x)
+
+
+@register_op("log10")
+def log10(x):
+    return jnp.log10(x)
+
+
+@register_op("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register_op("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register_op("rsqrt")
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register_op("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register_op("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register_op("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@register_op("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@register_op("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@register_op("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@register_op("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@register_op("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@register_op("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register_op("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register_op("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register_op("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@register_op("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@register_op("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@register_op("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register_op("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@register_op("round")
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+@register_op("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register_op("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@register_op("erf")
+def erf(x):
+    return jax.lax.erf(x)
+
+
+@register_op("erfinv")
+def erfinv(x):
+    return jax.lax.erf_inv(x)
+
+
+@register_op("lgamma")
+def lgamma(x):
+    return jax.lax.lgamma(x)
+
+
+@register_op("digamma")
+def digamma(x):
+    return jax.lax.digamma(x)
+
+
+@register_op("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_op("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@register_op("clip")
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@register_op("isnan", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op("isinf", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register_op("isfinite", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# -------------------------------------------------------------- compound
+
+
+@register_op("multiply_add")
+def multiply_add(x, y, z):
+    """fused multiply-add: x*y + z (XLA fuses this on the VPU)."""
+    return x * y + z
+
+
+@register_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(bx)) / beta)
+
+
+@register_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_op("cumsum")
+def cumsum(x, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1), axis=0)
+    return jnp.cumsum(x, axis=axis)
+
+
+@register_op("cumprod")
+def cumprod(x, dim=None):
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1), axis=0)
+    return jnp.cumprod(x, axis=dim)
+
+
+@register_op("cummax", differentiable=False)
+def cummax(x, axis=-1):
+    return jax.lax.cummax(x, axis=axis)
+
+
+@register_op("cummin", differentiable=False)
+def cummin(x, axis=-1):
+    return jax.lax.cummin(x, axis=axis)
+
+
+@register_op("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@register_op("gcd", differentiable=False)
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@register_op("lcm", differentiable=False)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+# ----------------------------------------------------------------- logic
+
+
+@register_op("equal", differentiable=False)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@register_op("not_equal", differentiable=False)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@register_op("less_than", differentiable=False)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@register_op("less_equal", differentiable=False)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@register_op("greater_than", differentiable=False)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@register_op("greater_equal", differentiable=False)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@register_op("logical_and", differentiable=False)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@register_op("logical_or", differentiable=False)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@register_op("logical_xor", differentiable=False)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@register_op("logical_not", differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@register_op("bitwise_and", differentiable=False)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@register_op("bitwise_or", differentiable=False)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@register_op("bitwise_xor", differentiable=False)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@register_op("bitwise_not", differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@register_op("allclose", differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("isclose", differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("equal_all", differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@register_op("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)

@@ -1,0 +1,410 @@
+"""Neural-net functional ops: conv/pool/norm/embedding/dropout/interpolate.
+
+Parity targets: python/paddle/nn/functional/{conv,pooling,norm,common}.py and
+the corresponding PHI kernels.  Convs/pools lower to lax.conv_general_dilated /
+lax.reduce_window, which XLA tiles onto the MXU; layout assignment is XLA's
+job so the public API stays NCHW like the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from .linalg import mxu_precision
+from ..core.random import split_key
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_padding(padding, k, stride, dilation, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    raise ValueError(f"bad padding: {padding}")
+
+
+@register_op("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, weight.shape[-2:], stride, dilation, 2)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"),
+    )
+    pet = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=pet,
+        precision=mxu_precision(x, weight))
+    if pet is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, weight.shape[-1:], stride, dilation, 1)
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape, ("NCH", "OIH", "NCH"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        precision=mxu_precision(x, weight))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+@register_op("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, weight.shape[-3:], stride, dilation, 3)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        precision=mxu_precision(x, weight))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    opad = _pair(output_padding)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    p = _conv_padding(padding, weight.shape[-2:], stride, dilation, 2)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    # gradient-of-conv formulation: lhs_dilation = stride
+    pad_t = [
+        (dilation[0] * (kh - 1) - p[0][0], dilation[0] * (kh - 1) - p[0][1] + opad[0]),
+        (dilation[1] * (kw - 1) - p[1][0], dilation[1] * (kw - 1) - p[1][1] + opad[1]),
+    ]
+    # weight layout is (in, out/groups, kh, kw) in paddle; flip spatial and
+    # swap io for the transposed conv
+    w = jnp.flip(weight, axis=(-2, -1))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)  # -> (out, in, kh, kw)
+    else:
+        ci, cog = weight.shape[0], weight.shape[1]
+        w = w.reshape(groups, ci // groups, cog, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * cog, ci // groups, kh, kw)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad_t,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        precision=mxu_precision(x, w))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ----------------------------------------------------------------- pooling
+
+
+def _ceil_extra_pad(size, k, s, pad_lo, pad_hi):
+    """Extra trailing pad so reduce_window emits ceil-mode output size."""
+    import math
+
+    out_ceil = math.ceil((size + pad_lo + pad_hi - k) / s) + 1
+    needed = (out_ceil - 1) * s + k - (size + pad_lo + pad_hi)
+    return max(needed, 0)
+
+
+@register_op("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _conv_padding(padding, k, s, (1, 1), 2)
+    if isinstance(p, str):
+        pads = p
+    else:
+        p = list(p)
+        if ceil_mode:
+            h, w = x.shape[2], x.shape[3]
+            p[0] = (p[0][0], p[0][1] + _ceil_extra_pad(h, k[0], s[0], *p[0]))
+            p[1] = (p[1][0], p[1][1] + _ceil_extra_pad(w, k[1], s[1], *p[1]))
+        pads = [(0, 0), (0, 0)] + p
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, neg_inf, jax.lax.max, window, strides, pads)
+
+
+@register_op("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _conv_padding(padding, k, s, (1, 1), 2)
+    if not isinstance(p, str):
+        p = list(p)
+        if ceil_mode:
+            h, w = x.shape[2], x.shape[3]
+            p[0] = (p[0][0], p[0][1] + _ceil_extra_pad(h, k[0], s[0], *p[0]))
+            p[1] = (p[1][0], p[1][1] + _ceil_extra_pad(w, k[1], s[1], *p[1]))
+    pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and not isinstance(pads, str):
+        counts = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, window, strides, pads)
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / (k[0] * k[1])
+
+
+@register_op("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(
+            x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    # general case: interpolate-style pooling windows
+    rows = [(int(jnp.floor(i * h / oh)), int(jnp.ceil((i + 1) * h / oh))) for i in range(oh)]
+    cols = [(int(jnp.floor(j * w / ow)), int(jnp.ceil((j + 1) * w / ow))) for j in range(ow)]
+    out = jnp.stack([
+        jnp.stack([jnp.mean(x[:, :, r0:r1, c0:c1], axis=(2, 3)) for (c0, c1) in cols], axis=-1)
+        for (r0, r1) in rows
+    ], axis=-2)
+    return out
+
+
+@register_op("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    raise NotImplementedError("non-divisible adaptive_max_pool2d")
+
+
+@register_op("global_avg_pool2d")
+def global_avg_pool2d(x, data_format="NCHW"):
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return jnp.mean(x, axis=axes, keepdims=True)
+
+
+# ------------------------------------------------------------------- norms
+
+
+@register_op("layer_norm")
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5, normalized_ndim=1):
+    axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
+    mean = jnp.mean(x.astype(jnp.float32), axis=axes, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=axes, keepdims=True)
+    out = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("rms_norm")
+def rms_norm(x, weight=None, epsilon=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register_op("batch_norm_infer")
+def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
+                     epsilon=1e-5, data_format="NCHW"):
+    shape = [1, -1] + [1] * (x.ndim - 2) if data_format.startswith("NC") else \
+            [1] * (x.ndim - 1) + [-1]
+    inv = jax.lax.rsqrt(running_var.reshape(shape) + epsilon)
+    out = (x - running_mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op("batch_norm_train")
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
+                     data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var) — caller updates running stats."""
+    if data_format.startswith("NC"):
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = [1, -1] + [1] * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = [1] * (x.ndim - 1) + [-1]
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@register_op("instance_norm")
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op("group_norm")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2))
+    acc = sum(padded[:, i : i + c] for i in range(size))
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+# --------------------------------------------------------------- embedding
+
+
+@register_op("embedding")
+def embedding(ids, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+# ----------------------------------------------------------------- dropout
+
+
+@register_op("dropout")
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", key=None):
+    if not training:
+        # downscale_in_infer: train keeps raw mask, infer scales by keep-prob
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    if p == 0.0:
+        return x
+    if key is None:
+        key = split_key()
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+    return jnp.where(mask, x, 0).astype(x.dtype)
+
+
+@register_op("dropout2d")
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        key = split_key()
+    keep = 1.0 - p
+    mask_shape = (x.shape[0], x.shape[1], 1, 1) if data_format == "NCHW" else \
+                 (x.shape[0], 1, 1, x.shape[3])
+    mask = jax.random.bernoulli(key, p=keep, shape=mask_shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+# ------------------------------------------------------------- interpolate
+
+
+@register_op("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = _pair(size)
+    method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "cubic",
+              "linear": "linear", "area": "linear"}[mode]
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+    return jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+    oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    patches = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patches.append(
+                xp[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0],
+                   j * d[1] : j * d[1] + ow * s[1] : s[1]])
+    out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+    return out.reshape(n, c * k[0] * k[1], oh * ow)

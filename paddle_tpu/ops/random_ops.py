@@ -1,0 +1,92 @@
+"""Random sampling ops (parity: python/paddle/tensor/random.py).
+
+Eager calls draw fresh subkeys from the framework's stateful stream
+(core/random.py); under jit an explicit ``key=`` must be threaded, keeping
+the pure/functional contract XLA needs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.random import split_key
+
+
+def _key(key):
+    return split_key() if key is None else key
+
+
+@register_op("uniform", differentiable=False)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):  # noqa: A002
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return jax.random.uniform(_key(key), tuple(shape), dtype=dt, minval=min, maxval=max)
+
+
+@register_op("randn", differentiable=False)
+def randn(shape, dtype=None, key=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return jax.random.normal(_key(key), tuple(shape), dtype=dt)
+
+
+@register_op("normal", differentiable=False)
+def normal(mean=0.0, std=1.0, shape=None, key=None):
+    base = jax.random.normal(_key(key), tuple(shape or ()), dtype=get_default_dtype())
+    return base * std + mean
+
+
+@register_op("randint", differentiable=False)
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), tuple(shape), low, high,
+                              dtype=convert_dtype(dtype))
+
+
+@register_op("randperm", differentiable=False)
+def randperm(n, dtype="int64", key=None):
+    return jax.random.permutation(_key(key), n).astype(convert_dtype(dtype))
+
+
+@register_op("rand", differentiable=False)
+def rand(shape, dtype=None, key=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return jax.random.uniform(_key(key), tuple(shape), dtype=dt)
+
+
+@register_op("bernoulli", differentiable=False)
+def bernoulli(x, key=None):
+    return jax.random.bernoulli(_key(key), p=x).astype(x.dtype)
+
+
+@register_op("multinomial", differentiable=False)
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        if x.ndim == 1:
+            return jax.random.categorical(_key(key), logits, shape=(num_samples,)).astype(jnp.int64)
+        return jax.random.categorical(
+            _key(key), logits[:, None, :], axis=-1, shape=(x.shape[0], num_samples)
+        ).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    k = _key(key)
+    g = jax.random.gumbel(k, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+@register_op("poisson", differentiable=False)
+def poisson(x, key=None):
+    return jax.random.poisson(_key(key), x).astype(get_default_dtype())
+
+
+@register_op("standard_normal", differentiable=False)
+def standard_normal(shape, dtype=None, key=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return jax.random.normal(_key(key), tuple(shape), dtype=dt)
+
+
+@register_op("exponential", differentiable=False)
+def exponential(x, lam=1.0, key=None):
+    return jax.random.exponential(_key(key), x.shape, dtype=x.dtype) / lam

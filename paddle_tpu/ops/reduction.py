@@ -1,0 +1,106 @@
+"""Reduction & statistics ops (parity: python/paddle/tensor/math.py + stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+
+def _axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+@register_op("sum")
+def sum(x, axis=None, keepdim=False, dtype=None):  # noqa: A001
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("max")
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("min")
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.prod(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("quantile")
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("nansum")
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("all", differentiable=False)
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("any", differentiable=False)
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op("argmax", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register_op("argmin", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(jnp.dtype(dtype))

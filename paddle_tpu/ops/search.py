@@ -1,0 +1,84 @@
+"""Search / sort ops (parity: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+
+@register_op("sort")
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register_op("argsort", differentiable=False)
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+@register_op("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
+    vals, idx = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        vals = -vals
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+    idx_sorted = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_x, k - 1, axis=axis)
+    idx = jnp.take(idx_sorted, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("mode")
+def mode(x, axis=-1, keepdim=False):
+    import numpy as np
+    import scipy.stats
+
+    xn = np.asarray(x)
+    m = scipy.stats.mode(xn, axis=axis, keepdims=keepdim)
+    return jnp.asarray(m.mode), jnp.asarray(m.count)
+
+
+@register_op("searchsorted", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]),
+        ).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("index_of_max", differentiable=False)
+def index_of_max(x):
+    return jnp.argmax(x)
+
+
+@register_op("bucketize", differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)

@@ -1,0 +1,48 @@
+"""Gradient clipping (parity: python/paddle/fluid/clip.py — ClipGradBy*).
+
+Clip objects are callables over lists of raw grad arrays, usable both from
+the eager optimizer step and inside jitted train steps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grads_by_global_norm"]
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            out.append((g.astype(jnp.float32) * factor).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        return clip_grads_by_global_norm(grads, self.clip_norm)
+
+
+def clip_grads_by_global_norm(grads, clip_norm):
+    gn_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+    gn = jnp.sqrt(gn_sq)
+    factor = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    return [(g.astype(jnp.float32) * factor).astype(g.dtype) for g in grads]

@@ -1,0 +1,186 @@
+"""Optimizer base.
+
+Parity: python/paddle/optimizer/optimizer.py.  TPU-first design: each
+optimizer defines a *pure functional update rule* (``init_slots`` /
+``update``) over jax arrays.  The eager ``step()`` applies it to ``p.grad``
+per parameter; the jit/pjit training path calls ``apply_gradients`` on whole
+parameter pytrees inside the compiled step (where ZeRO sharding of the slot
+pytree is just a sharding annotation — the stage-1/2 bookkeeping of the
+reference's sharding optimizers collapses into GSPMD).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        from .lr import LRScheduler
+
+        self._lr = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._step_count = 0
+        self._slots: dict[int, dict] = {}  # id(param) -> slot dict
+        self._master_weights: dict[int, jnp.ndarray] = {}
+        self._accumulators_built = False
+
+    # ------------------------------------------------------------ subclasses
+    def init_slots(self, param: jnp.ndarray) -> dict:
+        """Return the slot arrays (momentum/moments/…) for one parameter."""
+        return {}
+
+    def update(self, param, grad, slots, lr, step):
+        """Pure update rule: returns (new_param, new_slots)."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------------- lr plumbing
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    # --------------------------------------------------------------- eager path
+    def _param_lr(self, p, lr):
+        return lr * p.optimize_attr.get("learning_rate", 1.0)
+
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("Optimizer constructed without parameters")
+        lr = self.get_lr()
+        step = self._step_count + 1
+
+        grads = [(p, p.grad.data) for p in params
+                 if (not p.stop_gradient) and p.grad is not None]
+        if self._grad_clip is not None and grads:
+            clipped = self._grad_clip([g for _, g in grads])
+            grads = [(p, g) for (p, _), g in zip(grads, clipped)]
+        for p, g in grads:
+            g = self._apply_decay(p.data, g)
+            pid = id(p)
+            if pid not in self._slots:
+                self._slots[pid] = self.init_slots(p.data)
+                if self._multi_precision and p.data.dtype in (jnp.bfloat16, jnp.float16):
+                    self._master_weights[pid] = p.data.astype(jnp.float32)
+            slots = self._slots[pid]
+            if pid in self._master_weights:
+                master = self._master_weights[pid]
+                new_master, new_slots = self.update(
+                    master, g.astype(jnp.float32), slots,
+                    self._param_lr(p, lr), step)
+                self._master_weights[pid] = new_master
+                p.data = new_master.astype(p.data.dtype)
+            else:
+                new_param, new_slots = self.update(
+                    p.data, g.astype(p.data.dtype), slots,
+                    self._param_lr(p, lr), step)
+                p.data = new_param
+            self._slots[pid] = new_slots
+        self._step_count = step
+
+    def clear_grad(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ----------------------------------------------------------------- state
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                pid = id(p)
+                key = p.name or f"param_{i}"
+                if pid in self._slots:
+                    for sname, arr in self._slots[pid].items():
+                        out[f"{key}.{sname}"] = Tensor(arr)
+                if pid in self._master_weights:
+                    out[f"{key}.master"] = Tensor(self._master_weights[pid])
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                key = p.name or f"param_{i}"
+                pid = id(p)
+                slots = self.init_slots(p.data)
+                found = False
+                for sname in list(slots):
+                    k = f"{key}.{sname}"
+                    if k in state:
+                        v = state[k]
+                        slots[sname] = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+                        found = True
+                if found:
+                    self._slots[pid] = slots
+                mk = f"{key}.master"
+                if mk in state:
+                    v = state[mk]
+                    self._master_weights[pid] = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+        if self._lr_scheduler is not None and "LR_Scheduler" in state:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+
+    # ------------------------------------------------------- functional path
+    def init_state(self, params):
+        """params: pytree of arrays → optimizer state pytree (for jit path)."""
+        slots = jax.tree_util.tree_map(self.init_slots, params)
+        return {"step": jnp.zeros((), jnp.int32), "slots": slots}
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        """Pure: (params, grads, state) → (new_params, new_state).
+
+        Usable inside jit/pjit; ``lr`` may be a traced scalar.
+        """
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        if self._grad_clip is not None:
+            flat_g = self._grad_clip(flat_g)
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            if g is None:
+                new_p.append(p)
+                new_s.append(s)
+                continue
+            g = self._apply_decay(p, g.astype(p.dtype))
+            np_, ns_ = self.update(p, g, s, lr, step)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_p),
+            {"step": step, "slots": jax.tree_util.tree_unflatten(treedef, new_s)},
+        )
+
+    def _apply_decay(self, param, grad):
+        """Coupled L2 (reference default); AdamW overrides for decoupled."""
+        wd = self._weight_decay
+        if wd is None or wd == 0.0 or not isinstance(wd, (int, float)):
+            return grad
+        return grad + jnp.asarray(wd, dtype=grad.dtype) * param.astype(grad.dtype)

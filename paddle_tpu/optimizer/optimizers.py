@@ -1,0 +1,206 @@
+"""Concrete optimizers (parity: python/paddle/optimizer/{sgd,momentum,adam,adamw,lamb}.py
+and the PHI kernels paddle/phi/kernels/*/{sgd,momentum,adam,...}_kernel.*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adagrad", "RMSProp", "Adam", "AdamW", "Lamb",
+           "Adadelta", "Adamax"]
+
+
+class SGD(Optimizer):
+    def update(self, param, grad, slots, lr, step):
+        return param - lr * grad, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_slots(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def update(self, param, grad, slots, lr, step):
+        v = self._momentum * slots["velocity"] + grad
+        if self._nesterov:
+            new_param = param - lr * (grad + self._momentum * v)
+        else:
+            new_param = param - lr * v
+        return new_param, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, param):
+        return {"moment": jnp.full_like(param, self._init_acc)}
+
+    def update(self, param, grad, slots, lr, step):
+        m = slots["moment"] + grad * grad
+        new_param = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
+        return new_param, {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_slots(self, param):
+        s = {"mean_square": jnp.zeros_like(param),
+             "momentum": jnp.zeros_like(param)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(param)
+        return s
+
+    def update(self, param, grad, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * grad * grad
+        out = dict(slots)
+        out["mean_square"] = ms
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * grad
+            out["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * grad / denom
+        out["momentum"] = mom
+        return param - mom, out
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_slots(self, param):
+        # moments in fp32 for bf16 params: TPU-native mixed precision
+        mdt = jnp.float32 if param.dtype in (jnp.bfloat16, jnp.float16) else param.dtype
+        return {"moment1": jnp.zeros(param.shape, mdt),
+                "moment2": jnp.zeros(param.shape, mdt)}
+
+    def update(self, param, grad, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        gf = grad.astype(slots["moment1"].dtype)
+        m = b1 * slots["moment1"] + (1 - b1) * gf
+        v = b2 * slots["moment2"] + (1 - b2) * gf * gf
+        # bias correction with traced step
+        step_f = jnp.asarray(step, jnp.float32)
+        m_hat = m / (1 - jnp.power(b1, step_f))
+        v_hat = v / (1 - jnp.power(b2, step_f))
+        upd = (lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)).astype(param.dtype)
+        return param - upd, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision)
+        self._decoupled_wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decay(self, param, grad):
+        return grad  # decoupled — applied in update
+
+    def update(self, param, grad, slots, lr, step):
+        new_param, new_slots = super().update(param, grad, slots, lr, step)
+        wd = self._decoupled_wd
+        if wd:
+            new_param = new_param - (lr * wd) * param
+        return new_param, new_slots
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slots(self, param):
+        return {"moment1": jnp.zeros_like(param, jnp.float32),
+                "moment2": jnp.zeros_like(param, jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        gf = grad.astype(jnp.float32)
+        pf = param.astype(jnp.float32)
+        m = b1 * slots["moment1"] + (1 - b1) * gf
+        v = b2 * slots["moment2"] + (1 - b2) * gf * gf
+        step_f = jnp.asarray(step, jnp.float32)
+        m_hat = m / (1 - jnp.power(b1, step_f))
+        v_hat = v / (1 - jnp.power(b2, step_f))
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._wd * pf
+        p_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def init_slots(self, param):
+        return {"avg_squared_grad": jnp.zeros_like(param),
+                "avg_squared_update": jnp.zeros_like(param)}
+
+    def update(self, param, grad, slots, lr, step):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * slots["avg_squared_grad"] + (1 - rho) * grad * grad
+        upd = grad * jnp.sqrt(slots["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * slots["avg_squared_update"] + (1 - rho) * upd * upd
+        return param - lr * upd, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_slots(self, param):
+        return {"moment": jnp.zeros_like(param), "inf_norm": jnp.zeros_like(param)}
+
+    def update(self, param, grad, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(grad))
+        step_f = jnp.asarray(step, jnp.float32)
+        new_param = param - (lr / (1 - jnp.power(b1, step_f))) * m / (u + self._epsilon)
+        return new_param, {"moment": m, "inf_norm": u}

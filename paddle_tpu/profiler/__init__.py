@@ -1,0 +1,8 @@
+"""Profiler (parity: paddle/fluid/platform/profiler/ + python/paddle/profiler/).
+
+Host-side RecordEvent tracing with chrome-trace export, composed with jax's
+device profiler (which captures XLA/TPU activity the way CUPTI captures
+kernels for the reference).
+"""
+from .profiler import Profiler, RecordEvent, export_chrome_tracing  # noqa: F401
+from .timer import Benchmark  # noqa: F401

@@ -1,0 +1,164 @@
+"""Host tracer + device profiler bridge.
+
+Parity: platform/profiler/profiler.h:43 ``Profiler`` (HostTracer + CudaTracer
+→ NodeTrees → ChromeTracingLogger) and python/paddle/profiler/profiler.py:270.
+
+TPU design: host events are recorded in a ring buffer (HostEventRecorder
+analog); device-side activity is captured by jax.profiler (XLA's tracer —
+the CUPTI analog), exported as TensorBoard trace.  ``export_chrome_tracing``
+writes the host events in chrome-trace JSON.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["Profiler", "RecordEvent", "export_chrome_tracing", "ProfilerTarget"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    TPU = "tpu"
+
+
+class _HostEventRecorder:
+    def __init__(self):
+        self.events = []
+        self.lock = threading.Lock()
+        self.enabled = False
+
+    def record(self, name, start_ns, end_ns, tid):
+        if not self.enabled:
+            return
+        with self.lock:
+            self.events.append((name, start_ns, end_ns, tid))
+
+    def drain(self):
+        with self.lock:
+            out, self.events = self.events, []
+        return out
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """Scoped host event (parity: platform::RecordEvent, event_tracing.h)."""
+
+    def __init__(self, name, event_type="UserDefined"):
+        self.name = name
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+
+    def end(self):
+        if self._start is None:
+            return
+        _recorder.record(self.name, self._start, time.perf_counter_ns(),
+                         threading.get_ident())
+        self._start = None
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, with_device=True):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self.on_trace_ready = on_trace_ready
+        self.with_device = with_device and ProfilerTarget.TPU in self.targets
+        self._device_dir = None
+        self._events = []
+
+    def start(self):
+        _recorder.enabled = True
+        _recorder.drain()
+        if self.with_device:
+            import tempfile
+
+            import jax
+
+            self._device_dir = tempfile.mkdtemp(prefix="pt_prof_")
+            try:
+                jax.profiler.start_trace(self._device_dir)
+            except Exception:
+                self._device_dir = None
+
+    def stop(self):
+        _recorder.enabled = False
+        self._events = _recorder.drain()
+        if self._device_dir is not None:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def step(self):
+        pass
+
+    def export(self, path, format="json"):  # noqa: A002
+        export_events_chrome(self._events, path)
+
+    def summary(self, sorted_by="total", detail=True):
+        agg = {}
+        for name, s, e, _ in self._events:
+            tot, cnt = agg.get(name, (0, 0))
+            agg[name] = (tot + (e - s), cnt + 1)
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+        lines = [f"{'Name':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(us)':>10}"]
+        for name, (tot, cnt) in rows:
+            lines.append(f"{name:<40} {cnt:>8} {tot/1e6:>12.3f} {tot/1e3/max(cnt,1):>10.1f}")
+        return "\n".join(lines)
+
+    @property
+    def device_trace_dir(self):
+        return self._device_dir
+
+
+def export_events_chrome(events, path):
+    trace = {"traceEvents": []}
+    for name, start_ns, end_ns, tid in events:
+        trace["traceEvents"].append({
+            "name": name, "ph": "X", "ts": start_ns / 1000.0,
+            "dur": (end_ns - start_ns) / 1000.0, "pid": os.getpid(), "tid": tid,
+            "cat": "host",
+        })
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Returns an on_trace_ready callback (parity:
+    python/paddle/profiler/profiler.py:158)."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        prof.export(os.path.join(dir_name, f"{name}.json"))
+
+    return handler

@@ -1,0 +1,72 @@
+"""Benchmark timer (parity: python/paddle/profiler/timer.py:325 ``Benchmark``).
+
+Reports steady-state ips (items/sec) skipping warmup, plus reader cost —
+the in-repo throughput-metric mechanism used by every model benchmark.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["Benchmark"]
+
+
+class _StepInfo:
+    def __init__(self):
+        self.reader_cost = 0.0
+        self.batch_cost = 0.0
+        self.samples = 0
+        self.steps = 0
+
+    @property
+    def ips(self):
+        return self.samples / self.batch_cost if self.batch_cost > 0 else 0.0
+
+
+class Benchmark:
+    def __init__(self, warmup_steps: int = 10):
+        self.warmup_steps = warmup_steps
+        self.reset()
+
+    def reset(self):
+        self._step = 0
+        self._reader_start = None
+        self._batch_start = None
+        self._info = _StepInfo()
+
+    def before_reader(self):
+        self._reader_start = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_start is None:
+            return
+        cost = time.perf_counter() - self._reader_start
+        if self._step >= self.warmup_steps:
+            self._info.reader_cost += cost
+
+    def step_start(self):
+        self._batch_start = time.perf_counter()
+
+    def step_end(self, num_samples=1):
+        if self._batch_start is None:
+            return
+        cost = time.perf_counter() - self._batch_start
+        self._step += 1
+        if self._step > self.warmup_steps:
+            self._info.batch_cost += cost
+            self._info.samples += num_samples
+            self._info.steps += 1
+
+    def step_info(self, unit="samples"):
+        i = self._info
+        avg = i.batch_cost / i.steps if i.steps else 0.0
+        return {
+            "ips": i.ips,
+            "avg_batch_cost": avg,
+            "reader_cost": i.reader_cost / i.steps if i.steps else 0.0,
+            "steps": i.steps,
+            "unit": f"{unit}/sec",
+        }
+
+    @property
+    def ips(self):
+        return self._info.ips

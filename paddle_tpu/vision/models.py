@@ -1,0 +1,189 @@
+"""Vision models (parity: python/paddle/vision/models — ResNet/VGG/LeNet/MobileNet)."""
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "VGG", "vgg16", "MobileNetV1"]
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.Linear(120, 84), nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.flatten(1)
+        return self.fc(x)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(planes)
+        self.relu = nn.ReLU()
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(planes)
+        self.conv3 = nn.Conv2D(planes, planes * 4, 1, bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(planes * 4)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    """ResNet-{18,34,50,101,152} (parity: python/paddle/vision/models/resnet.py)."""
+
+    CFG = {18: (BasicBlock, [2, 2, 2, 2]), 34: (BasicBlock, [3, 4, 6, 3]),
+           50: (BottleneckBlock, [3, 4, 6, 3]), 101: (BottleneckBlock, [3, 4, 23, 3]),
+           152: (BottleneckBlock, [3, 8, 36, 3])}
+
+    def __init__(self, depth=50, num_classes=1000, with_pool=True):
+        super().__init__()
+        block, layers = self.CFG[depth]
+        self.inplanes = 64
+        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        self.avgpool = nn.AdaptiveAvgPool2D((1, 1)) if with_pool else None
+        self.fc = nn.Linear(512 * block.expansion, num_classes) if num_classes > 0 else None
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(planes * block.expansion))
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.avgpool is not None:
+            x = self.avgpool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def resnet18(pretrained=False, **kwargs):
+    return ResNet(18, **kwargs)
+
+
+def resnet34(pretrained=False, **kwargs):
+    return ResNet(34, **kwargs)
+
+
+def resnet50(pretrained=False, **kwargs):
+    return ResNet(50, **kwargs)
+
+
+def resnet101(pretrained=False, **kwargs):
+    return ResNet(101, **kwargs)
+
+
+class VGG(nn.Layer):
+    def __init__(self, cfg=(64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+                 num_classes=1000):
+        super().__init__()
+        layers = []
+        in_c = 3
+        for v in cfg:
+            if v == "M":
+                layers.append(nn.MaxPool2D(2, 2))
+            else:
+                layers += [nn.Conv2D(in_c, v, 3, padding=1), nn.ReLU()]
+                in_c = v
+        self.features = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+def vgg16(pretrained=False, **kwargs):
+    return VGG(**kwargs)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+
+        def dw_sep(inp, outp, stride):
+            return nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp), nn.ReLU(),
+                nn.Conv2D(inp, outp, 1, bias_attr=False),
+                nn.BatchNorm2D(outp), nn.ReLU())
+
+        s = lambda c: int(c * scale)
+        self.features = nn.Sequential(
+            nn.Conv2D(3, s(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(s(32)), nn.ReLU(),
+            dw_sep(s(32), s(64), 1), dw_sep(s(64), s(128), 2),
+            dw_sep(s(128), s(128), 1), dw_sep(s(128), s(256), 2),
+            dw_sep(s(256), s(256), 1), dw_sep(s(256), s(512), 2),
+            *[dw_sep(s(512), s(512), 1) for _ in range(5)],
+            dw_sep(s(512), s(1024), 2), dw_sep(s(1024), s(1024), 1))
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(x.flatten(1))

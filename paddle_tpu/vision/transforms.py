@@ -1,0 +1,113 @@
+"""Vision transforms (parity: python/paddle/vision/transforms) — numpy-based."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "RandomCrop",
+           "RandomHorizontalFlip", "CenterCrop", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW"):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if img.ndim == 2:
+            img = img[None]
+        elif img.ndim == 3 and self.data_format == "CHW" and img.shape[-1] in (1, 3, 4):
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+
+        img = np.asarray(img, dtype=np.float32)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        if chw:
+            c = img.shape[0]
+            out = jax.image.resize(img, (c, *self.size), method="bilinear")
+        else:
+            out = jax.image.resize(img, (*self.size, *img.shape[2:]), method="bilinear")
+        return np.asarray(out)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        h, w = (img.shape[1], img.shape[2]) if chw else (img.shape[0], img.shape[1])
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        return img[:, i:i + th, j:j + tw] if chw else img[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            cfg = [(0, 0), (p, p), (p, p)] if chw else [(p, p), (p, p)] + [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, cfg)
+        h, w = (img.shape[1], img.shape[2]) if chw else (img.shape[0], img.shape[1])
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[:, i:i + th, j:j + tw] if chw else img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            img = np.asarray(img)
+            return img[..., ::-1].copy() if img.ndim == 3 else img[:, ::-1].copy()
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)

@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+SURVEY.md §4: the reference conformance-tests device backends by re-targeting
+one harness per place; here the CPU platform with
+--xla_force_host_platform_device_count=8 is the fake multi-chip fixture that
+exercises the same shard_map/pjit code paths as a real TPU slice.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+# the axon TPU plugin ignores JAX_PLATFORMS env; the config knob wins
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    np.random.seed(1234)
+    import paddle_tpu
+
+    paddle_tpu.seed(1234)
+    yield

@@ -1,0 +1,91 @@
+"""Autograd tape tests (parity: eager backward semantics, backward.cc:522)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def test_simple_chain():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = x * x + x
+    loss = ops.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [5.0, 7.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [5.0, 5.0, 5.0])
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * x
+    z.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [2.0, 2.0, 2.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._node is None
+    assert y.stop_gradient
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b = ops.split(x, 2, axis=0)
+    (a.sum() * 2 + b.sum() * 3).backward()
+    expected = np.array([[2, 2, 2], [3, 3, 3]], np.float32)
+    np.testing.assert_allclose(np.asarray(x.grad.data), expected)
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    ((a + b) * (a - b)).sum().backward()  # (2x)(3x) pattern: 4x^2 - 9x^2
+    np.testing.assert_allclose(np.asarray(x.grad.data), [-10.0])
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * x        # x^2
+    z = y * y        # x^4 → dz/dx = 4 x^3 = 32
+    z.backward()
+    np.testing.assert_allclose(np.asarray(x.grad.data), [32.0])
+
+
+def test_grad_api():
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(np.asarray(g.data), [6.0])
+
+
+def test_backward_non_scalar_with_grad():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = x * 4
+    y.backward(paddle.to_tensor(np.full((2, 2), 0.5, np.float32)))
+    np.testing.assert_allclose(np.asarray(x.grad.data), np.full((2, 2), 2.0))
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(np.asarray(y.grad.data), [3.0, 3.0])

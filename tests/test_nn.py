@@ -1,0 +1,148 @@
+"""Layer zoo tests (parity: reference API/layer test style — dygraph vs numpy)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_forward():
+    layer = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    out = layer(x)
+    expected = np.asarray(x.data) @ np.asarray(layer.weight.data) + \
+        np.asarray(layer.bias.data)
+    np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-5)
+
+
+def test_linear_backward():
+    layer = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    loss = layer(x).sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.bias.grad is not None
+    np.testing.assert_allclose(np.asarray(layer.bias.grad.data), [2.0] * 3)
+
+
+def test_sequential_and_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    missing, unexpected = model2.set_state_dict(sd)
+    assert not missing and not unexpected
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(model(x).data),
+                               np.asarray(model2(x).data), atol=1e-6)
+
+
+def test_named_parameters_nested():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(2, 2)
+            self.block = nn.Sequential(nn.Linear(2, 2))
+
+        def forward(self, x):
+            return self.block(self.fc1(x))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "block.0.weight" in names
+    assert len(net.parameters()) == 4
+
+
+def test_dropout_modes():
+    layer = nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((100, 100), np.float32))
+    layer.eval()
+    np.testing.assert_allclose(np.asarray(layer(x).data), np.ones((100, 100)))
+    layer.train()
+    out = np.asarray(layer(x).data)
+    frac_zero = (out == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # upscale keeps expectation
+    assert abs(out.mean() - 1.0) < 0.1
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor(np.random.rand(4, 3, 5, 5).astype(np.float32) * 3 + 1)
+    bn.train()
+    out = bn(x)
+    o = np.asarray(out.data)
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0.0, atol=1e-4)
+    # running stats moved off init
+    assert not np.allclose(np.asarray(bn._mean.data), 0.0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm_layer():
+    ln = nn.LayerNorm(8)
+    x = paddle.to_tensor(np.random.rand(2, 5, 8).astype(np.float32))
+    out = np.asarray(ln(x).data)
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 6)
+    ids = paddle.to_tensor(np.array([[0, 1], [2, 3]]))
+    assert emb(ids).shape == [2, 2, 6]
+
+
+def test_conv_bn_relu_stack():
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2, 2))
+    x = paddle.to_tensor(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    assert model(x).shape == [2, 8, 4, 4]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(np.random.rand(2, 5, 16).astype(np.float32))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.to_tensor(np.random.rand(2, 6, 16).astype(np.float32))
+    assert enc(x).shape == [2, 6, 16]
+    # layers are independent copies
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=1)
+    x = paddle.to_tensor(np.random.rand(2, 5, 4).astype(np.float32))
+    out, states = lstm(x)
+    assert out.shape == [2, 5, 8]
+
+
+def test_rms_norm():
+    rn = nn.RMSNorm(8)
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    out = np.asarray(rn(x).data)
+    xn = np.asarray(x.data)
+    expected = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_train_eval_propagates():
+    model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    model.eval()
+    assert not model[1].training
+    model.train()
+    assert model[1].training
+
+
+def test_parameter_dtype_to():
+    model = nn.Linear(4, 3)
+    model.to(dtype="bfloat16")
+    assert model.weight.data.dtype == paddle.bfloat16

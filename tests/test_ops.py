@@ -1,0 +1,392 @@
+"""OpTest-style conformance harness.
+
+Parity with the reference's op_test.py:289 ``OpTest``: every op's forward is
+checked against a NumPy golden, and gradients are checked numerically
+(central differences) against the autograd tape — the same two assertions
+check_output_with_place/check_grad make.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central-difference jacobian-vector product with all-ones cotangent."""
+    x = np.asarray(x, dtype=np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (np.sum(fn(xp.astype(np.float32))) -
+                  np.sum(fn(xm.astype(np.float32)))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_op(op_fn, np_fn, shapes, atol=1e-5, grad=True, grad_atol=1e-2,
+             **kwargs):
+    arrays = [np.random.uniform(0.1, 1.0, s).astype(np.float32) for s in shapes]
+    # forward vs numpy golden
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+    out = op_fn(*tensors, **kwargs)
+    expected = np_fn(*arrays)
+    np.testing.assert_allclose(np.asarray(out.data), expected, atol=atol,
+                               rtol=1e-4)
+    if grad:
+        # analytic (tape) vs numeric grad w.r.t. first input
+        loss = ops.sum(out)
+        loss.backward()
+        analytic = np.asarray(tensors[0].grad.data)
+
+        def f(a0):
+            return np.asarray(
+                op_fn(paddle.to_tensor(a0),
+                      *[paddle.to_tensor(a) for a in arrays[1:]], **kwargs).data)
+
+        numeric = numeric_grad(f, arrays[0])
+        np.testing.assert_allclose(analytic, numeric, atol=grad_atol, rtol=1e-2)
+
+
+class TestElementwise:
+    def test_add(self):
+        check_op(ops.add, np.add, [(3, 4), (3, 4)])
+
+    def test_subtract(self):
+        check_op(ops.subtract, np.subtract, [(3, 4), (3, 4)])
+
+    def test_multiply(self):
+        check_op(ops.multiply, np.multiply, [(3, 4), (3, 4)])
+
+    def test_divide(self):
+        check_op(ops.divide, np.divide, [(3, 4), (3, 4)])
+
+    def test_broadcast_add(self):
+        check_op(ops.add, np.add, [(3, 4), (4,)])
+
+    def test_exp(self):
+        check_op(ops.exp, np.exp, [(5, 5)])
+
+    def test_log(self):
+        check_op(ops.log, np.log, [(5, 5)])
+
+    def test_sqrt(self):
+        check_op(ops.sqrt, np.sqrt, [(5, 5)])
+
+    def test_tanh(self):
+        check_op(ops.tanh, np.tanh, [(5, 5)])
+
+    def test_sigmoid(self):
+        check_op(ops.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [(5, 5)])
+
+    def test_maximum(self):
+        check_op(ops.maximum, np.maximum, [(4, 4), (4, 4)])
+
+    def test_pow(self):
+        check_op(lambda x: ops.pow(x, 2.0), lambda x: x ** 2, [(4, 4)])
+
+    def test_clip(self):
+        check_op(lambda x: ops.clip(x, 0.3, 0.7),
+                 lambda x: np.clip(x, 0.3, 0.7), [(4, 4)], grad=False)
+
+    def test_abs(self):
+        check_op(ops.abs, np.abs, [(4, 4)])
+
+    def test_rsqrt(self):
+        check_op(ops.rsqrt, lambda x: 1 / np.sqrt(x), [(4, 4)])
+
+
+class TestReduction:
+    def test_sum(self):
+        check_op(ops.sum, np.sum, [(3, 4)])
+
+    def test_sum_axis(self):
+        check_op(lambda x: ops.sum(x, axis=1),
+                 lambda x: np.sum(x, axis=1), [(3, 4)])
+
+    def test_mean(self):
+        check_op(ops.mean, np.mean, [(3, 4)])
+
+    def test_max(self):
+        check_op(ops.max, np.max, [(3, 4)], grad=False)
+
+    def test_min(self):
+        check_op(ops.min, np.min, [(3, 4)], grad=False)
+
+    def test_prod(self):
+        check_op(ops.prod, np.prod, [(2, 3)])
+
+    def test_std(self):
+        check_op(lambda x: ops.std(x, unbiased=False),
+                 lambda x: np.std(x), [(3, 4)])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp
+
+        check_op(ops.logsumexp, logsumexp, [(3, 4)])
+
+    def test_argmax(self):
+        x = np.random.rand(3, 5).astype(np.float32)
+        out = ops.argmax(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(np.asarray(out.data), np.argmax(x, 1))
+
+
+class TestLinalg:
+    def test_matmul(self):
+        check_op(ops.matmul, np.matmul, [(3, 4), (4, 5)])
+
+    def test_matmul_transpose(self):
+        check_op(lambda x, y: ops.matmul(x, y, transpose_y=True),
+                 lambda x, y: x @ y.T, [(3, 4), (5, 4)])
+
+    def test_bmm(self):
+        check_op(ops.bmm, np.matmul, [(2, 3, 4), (2, 4, 5)])
+
+    def test_einsum(self):
+        check_op(lambda x, y: ops.einsum("ij,jk->ik", x, y),
+                 lambda x, y: np.einsum("ij,jk->ik", x, y), [(3, 4), (4, 5)])
+
+    def test_norm(self):
+        check_op(ops.norm, np.linalg.norm, [(4, 4)])
+
+    def test_inverse(self):
+        x = np.random.rand(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        out = ops.inverse(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.data), np.linalg.inv(x),
+                                   atol=1e-4)
+
+    def test_cholesky(self):
+        a = np.random.rand(4, 4).astype(np.float32)
+        x = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        out = ops.cholesky(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.data), np.linalg.cholesky(x),
+                                   atol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape(self):
+        check_op(lambda x: ops.reshape(x, [4, 3]),
+                 lambda x: x.reshape(4, 3), [(3, 4)])
+
+    def test_transpose(self):
+        check_op(lambda x: ops.transpose(x, [1, 0]),
+                 lambda x: x.T, [(3, 4)])
+
+    def test_concat(self):
+        a = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.zeros((2, 3), np.float32), stop_gradient=False)
+        out = ops.concat([a, b], axis=0)
+        assert out.shape == [4, 3]
+        ops.sum(out * 2.0).backward()
+        np.testing.assert_allclose(np.asarray(a.grad.data), 2 * np.ones((2, 3)))
+
+    def test_split(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+        a, b = ops.split(x, 2, axis=1)
+        assert a.shape == [3, 2] and b.shape == [3, 2]
+
+    def test_split_sections(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+        a, b, c = ops.split(x, [1, 2, -1], axis=1)
+        assert a.shape == [3, 1] and b.shape == [3, 2] and c.shape == [3, 1]
+
+    def test_squeeze_unsqueeze(self):
+        x = paddle.to_tensor(np.ones((1, 3, 1), np.float32))
+        assert ops.squeeze(x).shape == [3]
+        assert ops.unsqueeze(x, 0).shape == [1, 1, 3, 1]
+
+    def test_gather(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        out = ops.gather(paddle.to_tensor(x), paddle.to_tensor(idx), axis=0)
+        np.testing.assert_allclose(np.asarray(out.data), x[idx])
+
+    def test_where(self):
+        check_op(lambda x, y: ops.where(x > 0.5, x, y),
+                 lambda x, y: np.where(x > 0.5, x, y), [(4, 4), (4, 4)],
+                 grad=False)
+
+    def test_stack(self):
+        xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+        out = ops.stack([paddle.to_tensor(x) for x in xs], axis=0)
+        np.testing.assert_allclose(np.asarray(out.data), np.stack(xs))
+
+    def test_pad(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        out = ops.pad(paddle.to_tensor(x), [1, 1], value=0.0)
+        assert out.shape == [2, 5]
+
+    def test_tile(self):
+        check_op(lambda x: ops.tile(x, [2, 2]),
+                 lambda x: np.tile(x, (2, 2)), [(2, 3)])
+
+    def test_cast(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        assert ops.cast(x, "int32").dtype == np.int32
+
+
+class TestActivation:
+    def test_relu(self):
+        x = np.random.randn(4, 4).astype(np.float32)
+        out = ops.relu(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.data), np.maximum(x, 0))
+
+    def test_gelu(self):
+        from scipy.stats import norm as scipy_norm
+
+        x = np.random.randn(4, 4).astype(np.float32)
+        out = ops.gelu(paddle.to_tensor(x))
+        expected = x * scipy_norm.cdf(x)
+        np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-5)
+
+    def test_softmax(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        out = ops.softmax(paddle.to_tensor(x))
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out.data), e / e.sum(-1, keepdims=True),
+                                   atol=1e-6)
+
+    def test_leaky_relu(self):
+        x = np.random.randn(4, 4).astype(np.float32)
+        out = ops.leaky_relu(paddle.to_tensor(x), 0.1)
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.where(x >= 0, x, 0.1 * x), atol=1e-6)
+
+
+class TestLoss:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 10).astype(np.float32)
+        labels = np.array([1, 3, 5, 7])
+        out = ops.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        # numpy golden
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.mean(np.log(p[np.arange(4), labels]))
+        np.testing.assert_allclose(float(out.data), expected, atol=1e-5)
+
+    def test_cross_entropy_grad(self):
+        logits = paddle.to_tensor(np.random.randn(4, 10).astype(np.float32),
+                                  stop_gradient=False)
+        labels = paddle.to_tensor(np.array([1, 3, 5, 7]))
+        loss = ops.cross_entropy(logits, labels)
+        loss.backward()
+        assert logits.grad is not None
+        np.testing.assert_allclose(np.asarray(logits.grad.data).sum(), 0.0,
+                                   atol=1e-5)
+
+    def test_mse(self):
+        check_op(ops.mse_loss, lambda a, b: np.mean((a - b) ** 2),
+                 [(4, 4), (4, 4)])
+
+    def test_bce_with_logits(self):
+        x = np.random.randn(8).astype(np.float32)
+        y = np.random.randint(0, 2, 8).astype(np.float32)
+        out = ops.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(y))
+        p = 1 / (1 + np.exp(-x))
+        expected = -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+        np.testing.assert_allclose(float(out.data), expected, atol=1e-5)
+
+
+class TestConvPool:
+    def test_conv2d_shape(self):
+        x = paddle.to_tensor(np.random.rand(2, 3, 8, 8).astype(np.float32))
+        w = paddle.to_tensor(np.random.rand(4, 3, 3, 3).astype(np.float32))
+        out = ops.conv2d(x, w, padding=1)
+        assert out.shape == [2, 4, 8, 8]
+
+    def test_conv2d_golden(self):
+        # golden: 1x1 conv == matmul over channels
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        w = np.random.rand(5, 3, 1, 1).astype(np.float32)
+        out = ops.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        expected = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+        np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-4)
+
+    def test_conv2d_grad(self):
+        x = paddle.to_tensor(np.random.rand(1, 2, 5, 5).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.random.rand(3, 2, 3, 3).astype(np.float32),
+                             stop_gradient=False)
+        out = ops.conv2d(x, w, padding=1)
+        ops.sum(out).backward()
+        assert x.grad.shape == [1, 2, 5, 5]
+        assert w.grad.shape == [3, 2, 3, 3]
+
+    def test_maxpool(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = ops.max_pool2d(paddle.to_tensor(x), 2, 2)
+        expected = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(np.asarray(out.data), expected)
+
+    def test_avgpool(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = ops.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        expected = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-6)
+
+    def test_layer_norm(self):
+        x = np.random.rand(2, 3, 8).astype(np.float32)
+        out = ops.layer_norm(paddle.to_tensor(x))
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        expected = (x - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-5)
+
+    def test_batch_norm_train(self):
+        x = np.random.rand(4, 3, 2, 2).astype(np.float32)
+        out, mean, var = ops.batch_norm_train(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(mean.data),
+                                   x.mean(axis=(0, 2, 3)), atol=1e-5)
+
+    def test_embedding(self):
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1, 2], [3, 4]])
+        out = ops.embedding(paddle.to_tensor(ids), paddle.to_tensor(w))
+        np.testing.assert_allclose(np.asarray(out.data), w[ids])
+
+
+class TestSearchSort:
+    def test_topk(self):
+        x = np.random.rand(3, 10).astype(np.float32)
+        vals, idx = ops.topk(paddle.to_tensor(x), k=3)
+        expected = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(np.asarray(vals.data), expected, atol=1e-6)
+
+    def test_sort(self):
+        x = np.random.rand(10).astype(np.float32)
+        out = ops.sort(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.data), np.sort(x))
+
+    def test_argsort(self):
+        x = np.random.rand(10).astype(np.float32)
+        out = ops.argsort(paddle.to_tensor(x))
+        np.testing.assert_array_equal(np.asarray(out.data), np.argsort(x))
+
+
+class TestAttention:
+    def test_sdpa_matches_naive(self):
+        q = np.random.randn(2, 4, 8, 16).astype(np.float32)
+        k = np.random.randn(2, 4, 8, 16).astype(np.float32)
+        v = np.random.randn(2, 4, 8, 16).astype(np.float32)
+        out = ops.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            use_flash=False)
+        # numpy golden
+        logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(16)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-4)
+
+    def test_sdpa_causal(self):
+        q = np.random.randn(1, 2, 6, 8).astype(np.float32)
+        out = ops.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True, use_flash=False)
+        assert out.shape == [1, 2, 6, 8]
