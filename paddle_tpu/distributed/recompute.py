@@ -1,0 +1,105 @@
+"""Recompute / activation checkpointing.
+
+Parity: python/paddle/distributed/fleet/utils/recompute.py:199
+(RecomputeFunction PyLayer) + meta_optimizers/recompute_optimizer.py.
+
+TPU-native: ``jax.checkpoint`` (remat) IS the mechanism — XLA re-emits the
+forward in the backward pass, trading FLOPs for HBM exactly like the
+reference's recompute, with policies replacing the manual checkpoint-var
+lists.  The eager wrapper preserves the reference's RNG-state semantics
+(dropout patterns replay identically) by reusing one key stream seed.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..core.random import key_stream, split_key
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "checkpoint_policy", "no_recompute"]
+
+
+def checkpoint_policy(name: str):
+    """Named remat policies (replaces the reference's checkpoint lists)."""
+    cp = jax.checkpoint_policies
+    return {
+        "full": cp.nothing_saveable,          # recompute everything
+        "dots": cp.checkpoint_dots,           # save matmul outputs
+        "dots_no_batch": cp.checkpoint_dots_with_no_batch_dims,
+        "nothing": cp.everything_saveable,    # no recompute
+    }[name]
+
+
+def recompute(function, *args, policy="full", use_reentrant=True, **kwargs):
+    """Eager recompute of ``function(*args)``.
+
+    The segment runs under jax.checkpoint inside a fresh vjp capture, so its
+    activations are rematerialized during backward; a fixed key makes dropout
+    bit-identical between the two passes (reference: get_rng_state_tracker
+    preservation, recompute.py:331).
+    """
+    seg_key = split_key()
+
+    # If the segment is a Layer (the common case), its parameters must be
+    # differentiable args of the pure segment, not closed-over constants —
+    # otherwise their grads would be silently dropped.
+    from ..nn.layer.layers import Layer as _Layer
+
+    target = getattr(function, "__self__", None)
+    layer = function if isinstance(function, _Layer) else (
+        target if isinstance(target, _Layer) else None)
+
+    if layer is not None:
+        named = dict(layer.named_parameters())
+        pnames = list(named)
+        pvals = [named[n] for n in pnames]
+
+        def pure_seg(params_and_inputs_dict):
+            p = {n: params_and_inputs_dict[n] for n in pnames}
+            ins = params_and_inputs_dict["__inputs__"]
+            with layer.swap_state(p):
+                with key_stream(seg_key):
+                    out = layer.forward(*[Tensor(a) for a in ins], **kwargs)
+            if isinstance(out, tuple):
+                return tuple(o.data if isinstance(o, Tensor) else o for o in out)
+            return out.data if isinstance(out, Tensor) else out
+
+        rematted = jax.checkpoint(pure_seg, policy=checkpoint_policy(policy))
+        bundle = {n: p for n, p in zip(pnames, pvals)}
+        bundle["__inputs__"] = tuple(args)
+        from ..core import dispatch
+
+        return dispatch._eager_run("recompute_segment", rematted, True,
+                                   (bundle,), {})
+
+    def pure_seg(*arrs):
+        with key_stream(seg_key):
+            out = function(*[Tensor(a) for a in arrs], **kwargs)
+        if isinstance(out, tuple):
+            return tuple(o.data if isinstance(o, Tensor) else o for o in out)
+        return out.data if isinstance(out, Tensor) else out
+
+    rematted = jax.checkpoint(pure_seg, policy=checkpoint_policy(policy))
+
+    # route through the dispatch layer so the tape records a single node
+    # whose vjp replays the segment under remat
+    from ..core import dispatch
+
+    return dispatch._eager_run("recompute_segment", rematted, True,
+                               tuple(args), {})
+
+
+def no_recompute(fn):
+    fn._no_recompute = True
+    return fn
+
+
+def remat(fn=None, policy="full", prevent_cse=True):
+    """Decorator for pure functions on the jit path: jax.checkpoint with a
+    named policy (used by the hybrid engine per transformer block)."""
+    if fn is None:
+        return functools.partial(remat, policy=policy, prevent_cse=prevent_cse)
+    return jax.checkpoint(fn, policy=checkpoint_policy(policy),
+                          prevent_cse=prevent_cse)

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the hot-op layer.
+
+Role parity: the reference's hand-fused CUDA ops (paddle/fluid/operators/
+fused/ — fused_attention_op.cu, fused_multi_transformer_op.cu) and its
+jit'ed CPU math (operators/math/jit).  On TPU, XLA already fuses elementwise
+chains into matmuls, so only genuinely structured kernels live here:
+flash attention (+ring variant for sequence parallelism).
+"""
+from .flash_attention import flash_attention, flash_attention_available  # noqa: F401

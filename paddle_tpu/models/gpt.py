@@ -1,0 +1,287 @@
+"""GPT — the flagship decoder-transformer family.
+
+Parity targets: the reference's GPT pretrain configs (BASELINE.md — GPT-3
+1.3B/6.7B hybrid DP+TP+PP+sharding) and its fused transformer ops
+(operators/fused/fused_multi_transformer_op.cu,
+incubate/nn/layer/fused_transformer.py).
+
+TPU-first design: the model is *functional-first* — parameters live in a
+pytree with blocks STACKED along a leading layer axis so the forward is a
+``lax.scan`` over layers (one compiled block body instead of L copies: fast
+compile, natural per-block remat, and the stacking axis doubles as the
+pipeline-stage axis).  An nn.Layer facade wraps the same functions for the
+eager API.  Attention routes through the Pallas flash kernel when available.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
+           "gpt_param_specs", "GPT", "GPT_CONFIGS"]
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class GPTConfig:
+    vocab_size: int = 50304          # multiple of 128 for MXU/TP tiling
+    max_seq_len: int = 1024
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: int = 3072
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+    use_flash: bool = True
+    remat: str = "dots"              # per-block checkpoint policy
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+    def jdtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+GPT_CONFIGS = {
+    # reference benchmark family (BASELINE.json configs)
+    "gpt2-small": GPTConfig(hidden=768, num_layers=12, num_heads=12,
+                            ffn_hidden=3072),
+    "gpt2-medium": GPTConfig(hidden=1024, num_layers=24, num_heads=16,
+                             ffn_hidden=4096),
+    "gpt2-large": GPTConfig(hidden=1280, num_layers=36, num_heads=20,
+                            ffn_hidden=5120),
+    "gpt3-1.3b": GPTConfig(hidden=2048, num_layers=24, num_heads=16,
+                           ffn_hidden=8192, max_seq_len=2048),
+    "gpt3-6.7b": GPTConfig(hidden=4096, num_layers=32, num_heads=32,
+                           ffn_hidden=16384, max_seq_len=2048),
+    "tiny": GPTConfig(vocab_size=1024, max_seq_len=128, hidden=128,
+                      num_layers=4, num_heads=4, ffn_hidden=512),
+}
+
+
+# ------------------------------------------------------------------ params
+
+
+def gpt_init(cfg: GPTConfig, key=None, dtype=None):
+    """Initialize the parameter pytree.  Block params are stacked on axis 0
+    (shape [L, ...]) for scan/pipeline use."""
+    key = key if key is not None else jax.random.key(0)
+    dt = dtype or cfg.jdtype()
+    D, F, L, V = cfg.hidden, cfg.ffn_hidden, cfg.num_layers, cfg.vocab_size
+    k = iter(jax.random.split(key, 16))
+
+    def init(key_, shape, std=0.02):
+        return (jax.random.normal(key_, shape, jnp.float32) * std).astype(dt)
+
+    resid_std = 0.02 / math.sqrt(2 * L)
+    params = {
+        "wte": init(next(k), (V, D)),
+        "wpe": init(next(k), (cfg.max_seq_len, D), 0.01),
+        "blocks": {
+            "ln1_g": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+            "qkv_w": init(next(k), (L, D, 3 * D)),
+            "qkv_b": jnp.zeros((L, 3 * D), dt),
+            "proj_w": init(next(k), (L, D, D), resid_std),
+            "proj_b": jnp.zeros((L, D), dt),
+            "ln2_g": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+            "up_w": init(next(k), (L, D, F)),
+            "up_b": jnp.zeros((L, F), dt),
+            "down_w": init(next(k), (L, F, D), resid_std),
+            "down_b": jnp.zeros((L, D), dt),
+        },
+        "lnf_g": jnp.ones((D,), dt), "lnf_b": jnp.zeros((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = init(next(k), (D, V))
+    return params
+
+
+def gpt_param_specs(cfg: GPTConfig, zero_stage=0):
+    """PartitionSpecs per param — the TP/ZeRO sharding plan.
+
+    mp: Megatron-style column/row split per block (qkv/up are column-split,
+    proj/down row-split → one psum per residual write, inserted by GSPMD).
+    Embedding is vocab-sharded over mp.  zero_stage>=3 additionally shards
+    the remaining replicated dim over 'sharding' (param ZeRO); stages 1/2
+    shard only optimizer state (see engine.make_opt_specs).
+    """
+    z = "sharding" if zero_stage >= 3 else None
+    specs = {
+        "wte": P("mp", z),
+        "wpe": P(None, None),
+        "blocks": {
+            "ln1_g": P(None, None), "ln1_b": P(None, None),
+            "qkv_w": P(None, z, "mp"), "qkv_b": P(None, "mp"),
+            "proj_w": P(None, "mp", z), "proj_b": P(None, None),
+            "ln2_g": P(None, None), "ln2_b": P(None, None),
+            "up_w": P(None, z, "mp"), "up_b": P(None, "mp"),
+            "down_w": P(None, "mp", z), "down_b": P(None, None),
+        },
+        "lnf_g": P(None), "lnf_b": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(z, "mp")
+    return specs
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b
+
+
+def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
+    """One transformer block: pre-LN attention + MLP.  bp holds this layer's
+    slice of the stacked block params."""
+    B, S, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+
+    h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+    qkv = jnp.einsum("bsd,de->bse", h, bp["qkv_w"]) + bp["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+
+    attn_out = None
+    if cfg.use_flash:
+        try:
+            from ..kernels.flash_attention import (flash_attention,
+                                                   flash_attention_available)
+
+            if flash_attention_available(q, k, v, None):
+                attn_out = flash_attention(q, k, v, causal=True)
+        except ImportError:
+            pass
+    if attn_out is None:
+        from ..ops.attention import _naive_attention
+
+        attn_out = _naive_attention(q, k, v, causal=True, training=False)
+    attn_out = attn_out.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + jnp.einsum("bsd,de->bse", attn_out, bp["proj_w"]) + bp["proj_b"]
+
+    h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+    h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
+    h = jax.nn.gelu(h, approximate=True)
+    h = jnp.einsum("bsf,fd->bsd", h, bp["down_w"]) + bp["down_b"]
+    return x + h
+
+
+def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None):
+    """tokens [B, S] → logits [B, S, V].  Blocks run under lax.scan with
+    per-block remat (cfg.remat policy)."""
+    B, S = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:S]
+    x = x.astype(cfg.jdtype())
+
+    block_params = blocks if blocks is not None else params["blocks"]
+
+    def body(carry, bp):
+        return _rematted_block(cfg)(bp, carry), None
+
+    x, _ = jax.lax.scan(body, x, block_params)
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["wte"])
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits
+
+
+@functools.lru_cache(maxsize=None)
+def _rematted_block(cfg: GPTConfig):
+    from ..distributed.recompute import checkpoint_policy
+
+    fn = lambda bp, x: gpt_block(cfg, bp, x)
+    if cfg.remat == "nothing":
+        return fn
+    return jax.checkpoint(fn, policy=checkpoint_policy(cfg.remat),
+                          prevent_cse=False)
+
+
+def gpt_loss(cfg: GPTConfig, params, tokens, labels=None):
+    """Next-token cross entropy in fp32 (the reference's
+    softmax_with_cross_entropy numerics)."""
+    if labels is None:
+        labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    logits = gpt_forward(cfg, params, tokens).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    mask = (labels != -100).astype(jnp.float32)
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def gpt_num_params(cfg: GPTConfig):
+    D, F, L, V = cfg.hidden, cfg.ffn_hidden, cfg.num_layers, cfg.vocab_size
+    per_block = 4 * D + D * 3 * D + 3 * D + D * D + D + D * F + F + F * D + D
+    n = V * D + cfg.max_seq_len * D + L * per_block + 2 * D
+    if not cfg.tie_embeddings:
+        n += D * V
+    return n
+
+
+def gpt_flops_per_token(cfg: GPTConfig, seq_len):
+    """Training FLOPs/token ≈ 6*N + attention term (per Chinchilla appendix)."""
+    n = gpt_num_params(cfg)
+    attn = 6 * cfg.num_layers * cfg.hidden * seq_len  # fwd+bwd qk/av matmuls
+    return 6 * n + 2 * attn
+
+
+# ------------------------------------------------------------ Layer facade
+
+
+from ..core.tensor import Parameter, Tensor  # noqa: E402
+from ..nn.layer.layers import Layer  # noqa: E402
+
+
+class GPT(Layer):
+    """Eager facade over the functional model (single-chip / small-scale)."""
+
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = GPTConfig(**kwargs)
+        self.config = config
+        from ..core.random import split_key
+
+        raw = gpt_init(config, key=split_key())
+        flat, self._treedef = jax.tree_util.tree_flatten(raw)
+        self._paths = [
+            "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(raw)[0]
+        ]
+        for name, arr in zip(self._paths, flat):
+            self.register_parameter(name.replace("/", "_"), Parameter(arr))
+
+    def _params_tree(self):
+        flat = [self._parameters[n.replace("/", "_")].data for n in self._paths]
+        return jax.tree_util.tree_unflatten(self._treedef, flat)
+
+    def forward(self, tokens, labels=None):
+        from ..core import dispatch
+
+        tokens_arr = tokens.data if isinstance(tokens, Tensor) else tokens
+        bundle = {n.replace("/", "_"): self._parameters[n.replace("/", "_")]
+                  for n in self._paths}
+
+        def pure(bundle_arrs, tok):
+            flat = [bundle_arrs[n.replace("/", "_")] for n in self._paths]
+            params = jax.tree_util.tree_unflatten(self._treedef, flat)
+            if labels is None:
+                return gpt_forward(self.config, params, tok)
+            lab = labels.data if isinstance(labels, Tensor) else labels
+            return gpt_loss(self.config, params, tok, lab)
+
+        return dispatch._eager_run("gpt_forward", pure, True,
+                                   (bundle, tokens_arr), {})
