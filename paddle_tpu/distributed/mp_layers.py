@@ -160,9 +160,10 @@ def parallel_cross_entropy(logits, label, mp_axis="mp", ignore_index=-100):
     offset = idx * vocab_per_part
 
     lf = logits.astype(jnp.float32)
-    local_max = jnp.max(lf, axis=-1, keepdims=True)
+    local_max = jnp.max(jax.lax.stop_gradient(lf), axis=-1, keepdims=True)
     gmax = jax.lax.pmax(local_max, mp_axis) if size != 1 else local_max
-    shifted = lf - gmax
+    # the shift is purely numerical (cancels in log-softmax): keep it out of AD
+    shifted = lf - jax.lax.stop_gradient(gmax)
     local_sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
     gsumexp = jax.lax.psum(local_sumexp, mp_axis) if size != 1 else local_sumexp
     # pick the true-class logit if it lives in this shard

@@ -148,10 +148,12 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
 
     h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
     qkv = jnp.einsum("bsd,de->bse", h, bp["qkv_w"]) + bp["qkv_b"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    # qkv columns are head-major [H, 3, hd] so a TP shard of the columns is
+    # a whole group of heads (keeps engine.py mp splits layout-compatible)
+    qkv = qkv.reshape(B, S, H, 3, hd)
+    q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)
+    k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
 
     attn_out = None
     if cfg.use_flash:
