@@ -23,8 +23,15 @@ Per-device program (step_local):
              + Ulysses sequence parallel (all_to_all seq↔heads around
                flash attention when sep>1)
   → vocab-parallel CE (psum over mp), loss psum over (dp,zr,sep[,pp])
-  → grads via jax.value_and_grad (collectives transpose automatically)
-  → grad sync: psum(dp,sep[,pp]) + psum_scatter over "sharding" (ZeRO-2)
+  → grads via jax.value_and_grad under shard_map(check_vma=True): the vma
+    type system makes AD insert the exact psums the reference's TP layers
+    hand-write (mp_layers.py:97,170 identity-fwd/allreduce-bwd pairs) —
+    pvary's transpose is psum — so grads arrive fully synced over every
+    axis their param is replicated on (dp, sharding, sep, and mp for the
+    mp-replicated leaves)
+  → ZeRO-2: each rank keeps its 1/zr chunk of the synced grad; XLA's
+    reduce-scatter-creator pass fuses the AD psum + own-chunk slice into a
+    reduce_scatter on ICI
   → global-norm clip (psum over sharding of chunk norms)
   → Adam on the local 1/zr optimizer-state chunk → all_gather(params)
 """
@@ -46,6 +53,17 @@ __all__ = ["HybridEngine", "EngineConfig"]
 
 DATA_AXES = ("dp", "sharding")      # axes that split the batch
 ALL_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def _psum_varying(x, axes=ALL_AXES):
+    """psum ``x`` over exactly the mesh axes it is device-varying on.
+
+    Under check_vma the varying-axis set lives in the aval; reducing only
+    those axes keeps the sum correct whether an upstream collective (e.g.
+    parallel CE's psum over 'mp') already de-varied an axis or not."""
+    vma = jax.typeof(x).vma
+    ax = tuple(a for a in axes if a in vma)
+    return jax.lax.psum(x, ax) if ax else x
 
 
 @dataclasses.dataclass
@@ -169,7 +187,9 @@ class HybridEngine:
                                (0, zr * chunk - n))
                 local = flat.reshape(zr, chunk)
                 # local zr axis is mapped over 'sharding': pick own row
-                idx = jax.lax.axis_index("sharding") if zr > 1 else 0
+                # (axis_index even at zr==1 so the result is sharding-varying,
+                # matching the opt spec's 'sharding' entry under check_vma)
+                idx = jax.lax.axis_index("sharding")
                 mine = jax.lax.dynamic_slice_in_dim(local, idx, 1, axis=0)
                 z = jnp.zeros((1, 1, 1, chunk), jnp.float32)
                 return {"m": z, "v": z,
@@ -180,7 +200,7 @@ class HybridEngine:
         slots_specs = jax.tree_util.tree_map(
             self._opt_leaf_spec, specs, is_leaf=lambda x: isinstance(x, P))
         mapped = shard_map(init_local, mesh=self.mesh, in_specs=(specs,),
-                           out_specs=slots_specs, check_vma=False)
+                           out_specs=slots_specs, check_vma=True)
         state = jax.jit(mapped)(params)
         return {"step": jnp.zeros((), jnp.int32), "slots": state}
 
@@ -275,6 +295,10 @@ class HybridEngine:
         def body(carry, bp):
             return block_fn(bp, carry), None
 
+        # blocks are pp-varying, so each block application makes the carry
+        # pp-varying: lift the init to keep scan's carry type fixed
+        if "pp" not in jax.typeof(x).vma:
+            x = jax.lax.pcast(x, ("pp",), to="varying")
         out, _ = jax.lax.scan(body, x, blocks_local)
         return out
 
@@ -309,7 +333,7 @@ class HybridEngine:
         if pp == 1:
             out = self._stage(params["blocks"], x)
             s, c = self._loss_head(params, out, labels)
-            total = jax.lax.psum(jnp.stack([s, c]), DATA_AXES + ("sep",))
+            total = _psum_varying(jnp.stack([s, c]))
             return total[0] / jnp.maximum(total[1], 1.0)
 
         # ---- pipeline ticks (GPipe-fill then drain; backward is the AD
@@ -326,22 +350,25 @@ class HybridEngine:
             state = jnp.where(pp_idx == 0, inp, state)
             y = self._stage(params["blocks"], state)
             m = t - (pp - 1)
-            is_out = (pp_idx == pp - 1) & (m >= 0)
+            # where-gate (not lax.cond): all devices run the loss head so the
+            # vma types stay uniform across ticks; XLA selects per device
+            is_out = ((pp_idx == pp - 1) & (m >= 0)).astype(jnp.float32)
             lab = lab_mb[jnp.clip(m, 0, num_micro - 1)]
-            s, c = jax.lax.cond(
-                is_out,
-                lambda: self._loss_head(params, y, lab),
-                lambda: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)))
-            loss_sum = loss_sum + s
-            cnt_sum = cnt_sum + c
+            s, c = self._loss_head(params, y, lab)
+            loss_sum = loss_sum + s * is_out
+            cnt_sum = cnt_sum + c * is_out
             state = jax.lax.ppermute(y, "pp", fwd_perm)
             return (state, loss_sum, cnt_sum), None
 
-        state0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        # carry init must already have the vma the loop body produces
+        # (scan requires fixed carry avals; pvary lifts the zeros)
+        carry_axes = tuple(sorted(set(jax.typeof(x).vma) | {"pp"}))
+        pvary = lambda v: jax.lax.pcast(v, carry_axes, to="varying")
+        state0 = pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype))
+        zero = lambda: pvary(jnp.zeros((), jnp.float32))
         (state, loss_sum, cnt_sum), _ = jax.lax.scan(
-            tick, (state0, 0.0, 0.0), jnp.arange(num_ticks))
-        total = jax.lax.psum(jnp.stack([loss_sum, cnt_sum]),
-                             DATA_AXES + ("sep", "pp"))
+            tick, (state0, zero(), zero()), jnp.arange(num_ticks))
+        total = _psum_varying(jnp.stack([loss_sum, cnt_sum]))
         return total[0] / jnp.maximum(total[1], 1.0)
 
     # ------------------------------------------------------------- the step
@@ -360,32 +387,32 @@ class HybridEngine:
 
         step = opt_state["step"] + 1
 
-        # --- grad sync + ZeRO scatter per leaf ---
+        # --- ZeRO chunking per leaf ---
+        # check_vma AD already psum'd every grad over the axes its param is
+        # replicated on (dp/sharding/sep/pp/mp as appropriate) — the vma
+        # type of each grad equals its param's.  Each rank keeps its own
+        # 1/zr chunk; XLA's reduce-scatter-creator fuses the AD all-reduce
+        # with this slice into a reduce_scatter over 'sharding'.
+        zr_idx = jax.lax.axis_index("sharding")
         g_chunks = []
         for path, g in zip(paths, flat_g):
-            axes = ["dp", "sep"]
-            if "blocks" not in path:
-                axes.append("pp")
-            g = jax.lax.psum(g, tuple(axes))
             n = int(np.prod(g.shape))
             chunk = -(-n // zr)
             gf = jnp.pad(g.reshape(-1).astype(jnp.float32),
                          (0, zr * chunk - n))
-            if zr > 1:
-                gc = jax.lax.psum_scatter(
-                    gf.reshape(zr, chunk), "sharding",
-                    scatter_dimension=0, tiled=False)
-            else:
-                gc = gf.reshape(chunk)
+            gc = jax.lax.dynamic_slice_in_dim(
+                gf.reshape(zr, chunk), zr_idx, 1, axis=0)[0]
             g_chunks.append(gc)
 
         # --- global-norm clip over the sharded chunks ---
+        # per-leaf vma-aware reduce: an mp-sharded leaf's chunks must be
+        # summed over mp (disjoint shards) while an mp-replicated leaf's
+        # must not (that would overcount by mp) — the reference's
+        # HybridParallelClipGrad makes the same is_distributed distinction
+        # (hybrid_parallel_optimizer.py:45)
         if ec.grad_clip and ec.grad_clip > 0:
-            local_sq = sum(jnp.sum(jnp.square(g)) for g in g_chunks)
-            if zr > 1:
-                gn_sq = jax.lax.psum(local_sq, "sharding")
-            else:
-                gn_sq = local_sq
+            gn_sq = sum(_psum_varying(jnp.sum(jnp.square(g)))
+                        for g in g_chunks)
             gnorm = jnp.sqrt(gn_sq)
             scale = jnp.minimum(1.0, ec.grad_clip / jnp.maximum(gnorm, 1e-12))
             g_chunks = [g * scale for g in g_chunks]
@@ -408,12 +435,13 @@ class HybridEngine:
                     not path.endswith("_b"):
                 upd = upd + decay * w_loc
             w_new = w_loc - lr * upd
-            # rebuild the full local fp32 param then cast to model dtype
-            if zr > 1:
-                full = jax.lax.all_gather(w_new, "sharding", axis=0,
-                                          tiled=False).reshape(-1)
-            else:
-                full = w_new
+            # rebuild the full fp32 param: scatter own chunk into zeros and
+            # psum over 'sharding' (psum is the only varying→invariant cast,
+            # so this is the type-correct all_gather; also identity at zr==1)
+            full = jnp.zeros((zr * w_new.shape[0],), jnp.float32)
+            full = jax.lax.dynamic_update_slice(
+                full, w_new, (zr_idx * w_new.shape[0],))
+            full = jax.lax.psum(full, "sharding")
             n = int(np.prod(p.shape))
             new_p = full[:n].reshape(p.shape).astype(p.dtype)
             new_flat_p.append(new_p)
@@ -441,7 +469,7 @@ class HybridEngine:
             in_specs=(specs, opt_specs, self.batch_spec(), self.batch_spec(),
                       P()),
             out_specs=(specs, opt_specs, P()),
-            check_vma=False,
+            check_vma=True,
         )
         self._step_fn = jax.jit(mapped, donate_argnums=(0, 1))
         return self._step_fn

@@ -105,6 +105,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of the ``like`` arrays' vma so
+    the pallas_call type-checks under shard_map(check_vma=True): the kernel
+    is elementwise in the device dimension, so outputs vary over every mesh
+    axis any input does (pallas does not validate this itself — an
+    under-declared vma would silently drop AD's psums downstream)."""
+    vmas = [getattr(jax.typeof(x), "vma", None) for x in like]
+    if all(v is None for v in vmas):
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vma = frozenset().union(*[v for v in vmas if v is not None])
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _flash_fwd(q, k, v, scale, causal, block_q, block_kv):
     B, H, S, D = q.shape
     bh = B * H
@@ -130,8 +143,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_kv):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, S, 1), jnp.float32),
+            _sds((bh, S, D), q.dtype, qf, kf, vf),
+            _sds((bh, S, 1), jnp.float32, qf, kf, vf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -265,8 +278,8 @@ def _flash_bwd(scale, causal, block_q, block_kv, res, g):
             pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+            _sds((bh, S, D), q.dtype, qf, kf, vf),
+            _sds((bh, S, D), q.dtype, qf, kf, vf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_kv, D), jnp.float32),
@@ -289,7 +302,7 @@ def _flash_bwd(scale, causal, block_q, block_kv, res, g):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+        out_shape=_sds((bh, S, D), q.dtype, qf, kf, vf),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, deltaf)
