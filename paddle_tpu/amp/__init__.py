@@ -1,9 +1,9 @@
 """AMP (parity: python/paddle/amp/ + fluid/dygraph/amp/).
 
 TPU-native stance: bf16 is the native mixed-precision dtype; it has fp32's
-exponent range, so dynamic loss scaling (the reference's GradScaler core
-job) is unnecessary — GradScaler keeps API parity but defaults to a no-op
-passthrough unless fp16 is explicitly requested.
+exponent range, so bf16 training needs no loss scaling.  GradScaler keeps
+the reference behavior (dynamic loss scaling on by default) so ported fp16
+code works unchanged; pass use_dynamic_loss_scaling=False for a bf16 no-op.
 """
 from .auto_cast import amp_guard, auto_cast, decorate, white_list  # noqa: F401
 from .grad_scaler import GradScaler  # noqa: F401

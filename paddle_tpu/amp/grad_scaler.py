@@ -1,13 +1,16 @@
 """GradScaler (parity: python/paddle/amp/grad_scaler.py:26).
 
-On TPU the default AMP dtype is bf16, whose exponent range matches fp32 —
-dynamic loss scaling is unnecessary, so with ``enable=True`` under bf16 this
-is an API-compatible passthrough (scale factor 1, no inf checks).  When the
-user explicitly trains fp16, the reference's dynamic loss-scaling state
-machine (check_finite_and_unscale + update_loss_scaling ops) runs.
+Reference parity: ``use_dynamic_loss_scaling`` defaults to True, so ported
+fp16 code gets the reference's dynamic loss-scaling state machine
+(check_finite_and_unscale + update_loss_scaling ops) out of the box.  On
+TPU the idiomatic AMP dtype is bf16, whose exponent range matches fp32 and
+needs no scaling — ``paddle_tpu.amp.auto_cast`` defaults to bf16 and users
+there can pass ``use_dynamic_loss_scaling=False`` (or just not use a
+scaler) for the passthrough fast path.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -20,10 +23,8 @@ class GradScaler:
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
                  decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=None):
         self._enable = enable
-        # bf16-native: scaling only activates if the user opts into dynamic
-        # loss scaling (fp16 path)
         self._use_dynamic = (use_dynamic_loss_scaling
-                             if use_dynamic_loss_scaling is not None else False)
+                             if use_dynamic_loss_scaling is not None else True)
         self._scale = float(init_loss_scaling) if self._use_dynamic else 1.0
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
@@ -42,13 +43,25 @@ class GradScaler:
         if not self._enable or self._scale == 1.0:
             return
         inv = 1.0 / self._scale
-        found_inf = False
+        # accumulate the inf check on-device; ONE host sync at the end
+        # (the reference's check_finite_and_unscale is likewise a single
+        # fused scan over all grads)
+        found = jnp.zeros((), jnp.bool_)
         for p in optimizer._parameter_list or []:
             if p.grad is not None:
                 g = p.grad.data * inv
-                found_inf = found_inf or bool(jnp.any(~jnp.isfinite(g)))
+                found = found | jnp.any(~jnp.isfinite(g))
                 p.grad = Tensor(g)
-        self._found_inf = found_inf
+        try:
+            self._found_inf = bool(found)
+        except jax.errors.TracerBoolConversionError:
+            raise RuntimeError(
+                "GradScaler's dynamic loss-scaling skip-step decision is "
+                "host-side (reference parity) and cannot run under "
+                "jax.jit. Either keep scaler.step()/minimize() outside "
+                "the jitted region, or train in bf16 and construct "
+                "GradScaler(use_dynamic_loss_scaling=False) for the "
+                "no-op passthrough.") from None
 
     def step(self, optimizer):
         if not self._enable:

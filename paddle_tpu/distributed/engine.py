@@ -244,7 +244,8 @@ class HybridEngine:
         from ..kernels.flash_attention import (flash_attention,
                                                flash_attention_available)
 
-        if self.cfg.use_flash and flash_attention_available(q, k, v, None):
+        if self.cfg.use_flash and flash_attention_available(q, k, v, None,
+                                                            causal=True):
             return flash_attention(q, k, v, causal=True)
         from ..ops.attention import _naive_attention
 

@@ -44,13 +44,16 @@ def _interpret():
     return (not _on_tpu()) or flag("tpu_interpret_pallas")
 
 
-def flash_attention_available(q, k, v, mask):
+def flash_attention_available(q, k, v, mask, causal=False):
     if not _PALLAS_OK or mask is not None:
         return False
     if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
         return False
     B, H, S, D = q.shape
-    if S < 128 or S % 128 != 0 or D > 256:
+    if D > 256:
+        return False
+    if S % 128 != 0 and not causal:
+        # non-128-multiple S is only supported via the causal pad path
         return False
     return True
 
@@ -341,6 +344,22 @@ def flash_attention(q, k, v, causal=False, scale=None,
     path spills the [S,S] scores to HBM).
     """
     S = q.shape[2]
+    if S % 128 != 0:
+        # TPU tiling needs S in 128-multiples.  Causal: zero-pad the tail
+        # (row i only attends j<=i, so pad rows can't leak into real rows)
+        # and slice back.  Non-causal padding would corrupt the softmax
+        # (padded keys score exp(0)=1) — reject with a clear error.
+        if not causal:
+            raise ValueError(
+                f"flash_attention requires seq_len % 128 == 0 for "
+                f"non-causal attention, got S={S}; pad the sequence or "
+                f"gate on flash_attention_available()")
+        pad = (-S) % 128
+        zpad = [(0, 0), (0, 0), (0, pad), (0, 0)]
+        out = flash_attention(jnp.pad(q, zpad), jnp.pad(k, zpad),
+                              jnp.pad(v, zpad), causal=causal, scale=scale,
+                              block_q=block_q, block_kv=block_kv)
+        return out[:, :, :S]
 
     def fit(b):
         b = min(b, S, 1024)

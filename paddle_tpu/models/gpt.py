@@ -161,7 +161,7 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
             from ..kernels.flash_attention import (flash_attention,
                                                    flash_attention_available)
 
-            if flash_attention_available(q, k, v, None):
+            if flash_attention_available(q, k, v, None, causal=True):
                 attn_out = flash_attention(q, k, v, causal=True)
         except ImportError:
             pass

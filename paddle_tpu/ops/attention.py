@@ -60,7 +60,7 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         try:
             from ..kernels.flash_attention import flash_attention_available, flash_attention
 
-            if flash_attention_available(q, k, v, attn_mask):
+            if flash_attention_available(q, k, v, attn_mask, causal=is_causal):
                 return flash_attention(q, k, v, causal=is_causal, scale=scale)
         except ImportError:
             pass

@@ -1,0 +1,68 @@
+"""Collective-op semantics + GradScaler defaults (ADVICE round-1 items)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from paddle_tpu.distributed.collective import ReduceOp, Group, all_reduce
+
+
+def test_allreduce_prod_signs_and_zeros():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    vals = np.array([[2.0, -3.0, 0.0, -1.0],
+                     [1.0, -2.0, 5.0, 4.0],
+                     [3.0, 1.0, 2.0, -2.0],
+                     [-1.0, 2.0, 1.0, 1.0]], np.float32)  # [rank, elem]
+    expect = np.prod(vals, axis=0)
+
+    def local(x):
+        return all_reduce(x, op=ReduceOp.PROD, group=Group(axis_name="x", gid=1))
+
+    out = jax.jit(shard_map(local, mesh=mesh, in_specs=P("x"),
+                            out_specs=P("x")))(vals.reshape(-1))
+    out = np.asarray(out).reshape(4, 4)
+    for r in range(4):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+
+
+def test_grad_scaler_dynamic_by_default():
+    from paddle_tpu.amp.grad_scaler import GradScaler
+    import paddle_tpu as paddle
+
+    s = GradScaler(init_loss_scaling=1024.0)
+    loss = paddle.to_tensor(np.float32(2.0))
+    scaled = s.scale(loss)
+    assert float(scaled.numpy() if hasattr(scaled, "numpy") else scaled) == 2048.0
+
+
+def test_allreduce_prod_int_exact():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    vals = np.array([3, 2, 7, 11], np.int32)  # product 462
+
+    def local(x):
+        return all_reduce(x, op=ReduceOp.PROD, group=Group(axis_name="x", gid=1))
+
+    out = jax.jit(shard_map(local, mesh=mesh, in_specs=P("x"),
+                            out_specs=P("x")))(vals)
+    assert np.asarray(out).tolist() == [462, 462, 462, 462]
+
+
+def test_grad_scaler_jit_raises_clear_error():
+    import pytest
+    from paddle_tpu.amp.grad_scaler import GradScaler
+    import paddle_tpu as paddle
+
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    s = GradScaler(init_loss_scaling=1024.0)
+
+    def train(xval):
+        x = paddle.Tensor(xval)
+        loss = s.scale(lin(x).sum())
+        loss.backward()
+        with pytest.raises(RuntimeError, match="outside"):
+            s.step(opt)
+        return xval
+
+    jax.jit(train)(jnp.ones((2, 4)))

@@ -62,3 +62,18 @@ def test_multiblock_seq():
     ref = _naive_attention(q, k, v, causal=True, training=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-3, rtol=2e-3)
+
+
+def test_ragged_seq_causal_pads():
+    # S not a 128-multiple: causal path zero-pads and slices back
+    q, k, v = _rand_qkv(S=200)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _naive_attention(q, k, v, causal=True, training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_ragged_seq_noncausal_raises():
+    q, k, v = _rand_qkv(S=200)
+    with pytest.raises(ValueError, match="128"):
+        flash_attention(q, k, v, causal=False)
