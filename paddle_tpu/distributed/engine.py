@@ -51,8 +51,8 @@ from .topology import build_mesh
 
 __all__ = ["HybridEngine", "EngineConfig"]
 
-DATA_AXES = ("dp", "sharding")      # axes that split the batch
-ALL_AXES = ("dp", "pp", "sharding", "sep", "mp")
+DATA_AXES = ("dp", "sharding", "ep")   # axes that split the batch
+ALL_AXES = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
 
 def _psum_varying(x, axes=ALL_AXES):
@@ -80,11 +80,12 @@ class EngineConfig:
 
 class HybridEngine:
     def __init__(self, cfg: GPTConfig, dp=1, pp=1, sharding=1, sep=1, mp=1,
-                 engine_cfg: EngineConfig = None, mesh: Mesh = None,
+                 ep=1, engine_cfg: EngineConfig = None, mesh: Mesh = None,
                  devices=None):
         self.cfg = cfg
         self.ec = engine_cfg or EngineConfig()
         self.dp, self.pp, self.zr, self.sep, self.mp = dp, pp, sharding, sep, mp
+        self.ep = ep
         assert cfg.num_layers % pp == 0, "layers must divide pp"
         assert cfg.hidden % mp == 0 and cfg.ffn_hidden % mp == 0
         assert cfg.num_heads % mp == 0
@@ -95,25 +96,43 @@ class HybridEngine:
         if pp > 1:
             assert self.ec.num_microbatches >= pp, \
                 "need microbatches >= pp for the pipeline"
+        if ep > 1:
+            assert cfg.moe_experts > 0, "ep>1 needs a MoE model"
+        if cfg.moe_experts:
+            assert cfg.moe_experts % ep == 0, "experts must divide ep"
         self.mesh = mesh if mesh is not None else build_mesh(
-            dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp, devices=devices)
+            dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp, ep=ep,
+            devices=devices)
         self._step_fn = None
 
     # ------------------------------------------------------------ shardings
     def param_specs(self):
         """Manual-mode layout: blocks pp-sharded on the layer axis, Megatron
         column/row splits on mp, everything else replicated."""
+        blocks = {
+            "ln1_g": P("pp", None), "ln1_b": P("pp", None),
+            "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
+            "proj_w": P("pp", "mp", None), "proj_b": P("pp", None),
+            "ln2_g": P("pp", None), "ln2_b": P("pp", None),
+        }
+        if self.cfg.moe_experts:
+            # Mixtral-style EP: experts sharded over "ep"; the expert FFN
+            # inner dim stays unsharded (ep takes mp's role for the FFN)
+            blocks.update({
+                "gate_w": P("pp", None, None),
+                "up_w": P("pp", "ep", None, None), "up_b": P("pp", "ep", None),
+                "down_w": P("pp", "ep", None, None),
+                "down_b": P("pp", "ep", None),
+            })
+        else:
+            blocks.update({
+                "up_w": P("pp", None, "mp"), "up_b": P("pp", "mp"),
+                "down_w": P("pp", "mp", None), "down_b": P("pp", None),
+            })
         return {
             "wte": P("mp", None),                     # vocab-parallel
             "wpe": P(None, None),
-            "blocks": {
-                "ln1_g": P("pp", None), "ln1_b": P("pp", None),
-                "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
-                "proj_w": P("pp", "mp", None), "proj_b": P("pp", None),
-                "ln2_g": P("pp", None), "ln2_b": P("pp", None),
-                "up_w": P("pp", None, "mp"), "up_b": P("pp", "mp"),
-                "down_w": P("pp", "mp", None), "down_b": P("pp", None),
-            },
+            "blocks": blocks,
             "lnf_g": P(None), "lnf_b": P(None),
         }
 
@@ -154,12 +173,14 @@ class HybridEngine:
                 names.update(entry)
             else:
                 names.add(entry)
-        return ("pp" in names), ("mp" in names)
+        return names
 
     def _opt_leaf_spec(self, spec):
-        has_pp, has_mp = self._leaf_axes(spec)
-        s = P("pp" if has_pp else None, "mp" if has_mp else None,
-              "sharding", None)
+        names = self._leaf_axes(spec)
+        # slot layout [pp?, mp-or-ep?, zr, chunk]; no leaf carries both
+        # mp and ep (experts are not tensor-parallel)
+        second = "mp" if "mp" in names else ("ep" if "ep" in names else None)
+        s = P("pp" if "pp" in names else None, second, "sharding", None)
         return {"m": s, "v": s, "master": s}
 
     def opt_specs(self):
@@ -276,15 +297,27 @@ class HybridEngine:
         x = x + proj + bp["proj_b"]
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+        if cfg.moe_experts:
+            from .moe import moe_layer
+
+            y, aux = moe_layer(
+                {"gate_w": bp["gate_w"], "up_w": bp["up_w"],
+                 "up_b": bp["up_b"], "down_w": bp["down_w"],
+                 "down_b": bp["down_b"]},
+                h, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                ep_axis="ep" if self.ep > 1 else None)
+            return x + y, aux
         h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
         h = jax.nn.gelu(h, approximate=True)
         down = jnp.einsum("bsf,fd->bsd", h, bp["down_w"])
         if mp > 1:
             down = jax.lax.psum(down, "mp")
-        return x + down + bp["down_b"]
+        return x + down + bp["down_b"], jnp.zeros((), jnp.float32)
 
     def _stage(self, blocks_local, x):
-        """Scan this pipeline stage's blocks with per-block remat."""
+        """Scan this pipeline stage's blocks with per-block remat.
+        Returns (x, aux_sum) — the stage's summed MoE aux loss."""
         from .recompute import checkpoint_policy
 
         block_fn = lambda bp, x: self._block(bp, x)
@@ -294,14 +327,17 @@ class HybridEngine:
                 prevent_cse=False)
 
         def body(carry, bp):
-            return block_fn(bp, carry), None
+            x, aux_sum = carry
+            x, aux = block_fn(bp, x)
+            return (x, aux_sum + aux), None
 
         # blocks are pp-varying, so each block application makes the carry
         # pp-varying: lift the init to keep scan's carry type fixed
         if "pp" not in jax.typeof(x).vma:
             x = jax.lax.pcast(x, ("pp",), to="varying")
-        out, _ = jax.lax.scan(body, x, blocks_local)
-        return out
+        aux0 = jnp.zeros((), jnp.float32) + 0.0 * x.mean().astype(jnp.float32)
+        (out, aux_sum), _ = jax.lax.scan(body, (x, aux0), blocks_local)
+        return out, aux_sum
 
     def _loss_head(self, params, x, labels):
         """Final LN + tied-embedding logits + vocab-parallel CE.

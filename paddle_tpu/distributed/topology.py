@@ -67,20 +67,21 @@ class CommunicateTopology:
 
 
 _AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
-             "sep": "sep"}
+             "sep": "sep", "expert": "ep"}
 
 
-def build_mesh(dp=1, pp=1, sharding=1, mp=1, sep=1, devices=None):
+def build_mesh(dp=1, pp=1, sharding=1, mp=1, sep=1, ep=1, devices=None):
     """Build the jax Mesh with the canonical axis order.  Total must equal
     len(devices).  Axes of size 1 are kept (zero-cost) so shardings can
-    always name them."""
+    always name them.  "ep" (expert parallel) sits just outside "mp" so the
+    MoE all_to_all rides nearest-neighbor ICI links."""
     devices = np.asarray(devices if devices is not None else jax.devices())
-    shape = (dp, pp, sharding, sep, mp)
+    shape = (dp, pp, sharding, sep, ep, mp)
     if int(np.prod(shape)) != devices.size:
         raise ValueError(
             f"mesh {shape} needs {int(np.prod(shape))} devices, have {devices.size}")
     dev_grid = devices.reshape(shape)
-    return Mesh(dev_grid, ("dp", "pp", "sharding", "sep", "mp"))
+    return Mesh(dev_grid, ("dp", "pp", "sharding", "sep", "ep", "mp"))
 
 
 class HybridCommunicateGroup:
@@ -88,7 +89,7 @@ class HybridCommunicateGroup:
 
     def __init__(self, topology: CommunicateTopology = None, dp_degree=1,
                  mp_degree=1, pp_degree=1, sharding_degree=1, sep_degree=1,
-                 devices=None):
+                 ep_degree=1, devices=None):
         if topology is not None:
             dims = dict(zip(topology.get_hybrid_group_names(), topology._dims))
             dp_degree = dims.get("data", 1)
@@ -96,22 +97,27 @@ class HybridCommunicateGroup:
             sharding_degree = dims.get("sharding", 1)
             mp_degree = dims.get("model", 1)
             sep_degree = dims.get("sep", 1)
+            ep_degree = dims.get("expert", 1)
         self._topo = topology or CommunicateTopology(
-            ("data", "pipe", "sharding", "sep", "model"),
-            (dp_degree, pp_degree, sharding_degree, sep_degree, mp_degree))
+            ("data", "pipe", "sharding", "sep", "expert", "model"),
+            (dp_degree, pp_degree, sharding_degree, sep_degree, ep_degree,
+             mp_degree))
         self._dp_degree = dp_degree
         self._mp_degree = mp_degree
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
+        self._ep_degree = ep_degree
         self.mesh = build_mesh(dp_degree, pp_degree, sharding_degree,
-                               mp_degree, sep_degree, devices=devices)
+                               mp_degree, sep_degree, ep_degree,
+                               devices=devices)
         self._groups = {
             "dp": Group(axis_name="dp", gid=1),
             "pp": Group(axis_name="pp", gid=2),
             "sharding": Group(axis_name="sharding", gid=3),
             "mp": Group(axis_name="mp", gid=4),
             "sep": Group(axis_name="sep", gid=5),
+            "ep": Group(axis_name="ep", gid=7),
         }
 
     # parallel mode resolution — parity fleet_base.py:1043
@@ -141,6 +147,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self):
         return self._sep_degree
 
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
     # groups ------------------------------------------------------------
     def get_data_parallel_group(self):
         return self._groups["dp"]
@@ -156,6 +165,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._groups["sep"]
+
+    def get_expert_parallel_group(self):
+        return self._groups["ep"]
 
     def get_check_parallel_group(self):
         return Group(axis_name=("pp", "sharding", "mp"), gid=6)

@@ -39,6 +39,12 @@ class GPTConfig:
     use_flash: bool = True
     remat: str = "dots"              # per-block checkpoint policy
     tie_embeddings: bool = True
+    # MoE (Mixtral-style): >0 replaces every block's dense FFN with a
+    # moe_experts-expert MoE of the same per-expert hidden (ffn_hidden)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self):
@@ -90,13 +96,27 @@ def gpt_init(cfg: GPTConfig, key=None, dtype=None):
             "proj_w": init(next(k), (L, D, D), resid_std),
             "proj_b": jnp.zeros((L, D), dt),
             "ln2_g": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+        },
+        "lnf_g": jnp.ones((D,), dt), "lnf_b": jnp.zeros((D,), dt),
+    }
+    E = cfg.moe_experts
+    if E:
+        params["blocks"].update({
+            # gate in fp32: routing decisions are precision-sensitive
+            "gate_w": (jax.random.normal(next(k), (L, D, E), jnp.float32)
+                       * 0.02),
+            "up_w": init(next(k), (L, E, D, F)),
+            "up_b": jnp.zeros((L, E, F), dt),
+            "down_w": init(next(k), (L, E, F, D), resid_std),
+            "down_b": jnp.zeros((L, E, D), dt),
+        })
+    else:
+        params["blocks"].update({
             "up_w": init(next(k), (L, D, F)),
             "up_b": jnp.zeros((L, F), dt),
             "down_w": init(next(k), (L, F, D), resid_std),
             "down_b": jnp.zeros((L, D), dt),
-        },
-        "lnf_g": jnp.ones((D,), dt), "lnf_b": jnp.zeros((D,), dt),
-    }
+        })
     if not cfg.tie_embeddings:
         params["lm_head"] = init(next(k), (D, V))
     return params
@@ -120,11 +140,20 @@ def gpt_param_specs(cfg: GPTConfig, zero_stage=0):
             "qkv_w": P(None, z, "mp"), "qkv_b": P(None, "mp"),
             "proj_w": P(None, "mp", z), "proj_b": P(None, None),
             "ln2_g": P(None, None), "ln2_b": P(None, None),
-            "up_w": P(None, z, "mp"), "up_b": P(None, "mp"),
-            "down_w": P(None, "mp", z), "down_b": P(None, None),
         },
         "lnf_g": P(None), "lnf_b": P(None),
     }
+    if cfg.moe_experts:
+        specs["blocks"].update({
+            "gate_w": P(None, None, None),
+            "up_w": P(None, "ep", z, None), "up_b": P(None, "ep", None),
+            "down_w": P(None, "ep", z, None), "down_b": P(None, "ep", None),
+        })
+    else:
+        specs["blocks"].update({
+            "up_w": P(None, z, "mp"), "up_b": P(None, "mp"),
+            "down_w": P(None, "mp", z), "down_b": P(None, None),
+        })
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(z, "mp")
     return specs
@@ -141,8 +170,9 @@ def _layer_norm(x, g, b, eps=1e-5):
 
 
 def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
-    """One transformer block: pre-LN attention + MLP.  bp holds this layer's
-    slice of the stacked block params."""
+    """One transformer block: pre-LN attention + MLP (dense or MoE).
+    Returns (x, aux) where aux is the MoE load-balance loss (0 for dense).
+    bp holds this layer's slice of the stacked block params."""
     B, S, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
 
@@ -173,15 +203,26 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
     x = x + jnp.einsum("bsd,de->bse", attn_out, bp["proj_w"]) + bp["proj_b"]
 
     h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+    if cfg.moe_experts:
+        from ..distributed.moe import moe_layer
+
+        y, aux = moe_layer(
+            {"gate_w": bp["gate_w"], "up_w": bp["up_w"], "up_b": bp["up_b"],
+             "down_w": bp["down_w"], "down_b": bp["down_b"]},
+            h, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor)
+        return x + y, aux
     h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
     h = jax.nn.gelu(h, approximate=True)
     h = jnp.einsum("bsf,fd->bsd", h, bp["down_w"]) + bp["down_b"]
-    return x + h
+    return x + h, jnp.zeros((), jnp.float32)
 
 
-def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None):
+def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None,
+                return_aux=False):
     """tokens [B, S] → logits [B, S, V].  Blocks run under lax.scan with
-    per-block remat (cfg.remat policy)."""
+    per-block remat (cfg.remat policy).  return_aux=True also returns the
+    summed MoE load-balance loss."""
     B, S = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:S]
     x = x.astype(cfg.jdtype())
@@ -189,15 +230,18 @@ def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None):
     block_params = blocks if blocks is not None else params["blocks"]
 
     def body(carry, bp):
-        return _rematted_block(cfg)(bp, carry), None
+        x, aux_sum = carry
+        x, aux = _rematted_block(cfg)(bp, x)
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(body, x, block_params)
+    (x, aux_sum), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), block_params)
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["wte"])
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
-    return logits
+    return (logits, aux_sum) if return_aux else logits
 
 
 @functools.lru_cache(maxsize=None)
@@ -216,18 +260,29 @@ def gpt_loss(cfg: GPTConfig, params, tokens, labels=None):
     softmax_with_cross_entropy numerics)."""
     if labels is None:
         labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-    logits = gpt_forward(cfg, params, tokens).astype(jnp.float32)
+    logits, aux = gpt_forward(cfg, params, tokens, return_aux=True)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     safe = jnp.maximum(labels, 0)
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     mask = (labels != -100).astype(jnp.float32)
-    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    ce = -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if cfg.moe_experts:
+        # per-layer mean aux (sum over layers / L keeps the weight's scale
+        # independent of depth, matching the engine's normalization)
+        ce = ce + cfg.moe_aux_weight * aux / cfg.num_layers
+    return ce
 
 
 def gpt_num_params(cfg: GPTConfig):
     D, F, L, V = cfg.hidden, cfg.ffn_hidden, cfg.num_layers, cfg.vocab_size
-    per_block = 4 * D + D * 3 * D + 3 * D + D * D + D + D * F + F + F * D + D
-    n = V * D + cfg.max_seq_len * D + L * per_block + 2 * D
+    attn_part = 4 * D + D * 3 * D + 3 * D + D * D + D
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        ffn_part = D * E + E * (D * F + F + F * D + D)
+    else:
+        ffn_part = D * F + F + F * D + D
+    n = V * D + cfg.max_seq_len * D + L * (attn_part + ffn_part) + 2 * D
     if not cfg.tie_embeddings:
         n += D * V
     return n
