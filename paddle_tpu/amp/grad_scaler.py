@@ -33,6 +33,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False  # once-per-step latch (explicit-unscale flow)
 
     def scale(self, loss):
         if not self._enable or self._scale == 1.0:
@@ -40,8 +41,16 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable or self._scale == 1.0:
+        # while dynamic scaling is on the finite check must ALWAYS run, even
+        # when the scale has decayed to the 1.0 floor (reference: the
+        # check_finite_and_unscale op runs unconditionally)
+        if not self._enable or (not self._use_dynamic and self._scale == 1.0):
             return
+        if self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this scaler since "
+                "the last step()")
+        self._unscaled = True
         inv = 1.0 / self._scale
         # accumulate the inf check on-device; ONE host sync at the end
         # (the reference's check_finite_and_unscale is likewise a single
@@ -67,7 +76,7 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        if self._scale != 1.0:
+        if not self._unscaled and (self._use_dynamic or self._scale != 1.0):
             self.unscale_(optimizer)
         if self._found_inf:
             self._bad_steps += 1
@@ -82,6 +91,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def update(self):
         pass

@@ -103,20 +103,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     elif op == ReduceOp.AVG:
         out = jax.lax.pmean(x, axis)
     elif op == ReduceOp.PROD:
-        # sign-safe product in f32: |x| via exp(psum(log|x|)) with a
-        # 0-element guard, sign via parity of negative counts (log(x)
-        # alone is NaN for x<0 and -inf for 0); integer dtypes round the
-        # exp/log round-trip before casting back
-        xf = x.astype(jnp.float32)
-        has_zero = jax.lax.pmax(jnp.where(xf == 0, 1.0, 0.0), axis)
-        neg = jax.lax.psum(jnp.where(xf < 0, 1, 0), axis)
-        sign = jnp.where(neg % 2 == 1, -1.0, 1.0)
-        absx = jnp.where(xf == 0, 1.0, jnp.abs(xf))
-        mag = jnp.exp(jax.lax.psum(jnp.log(absx), axis))
-        out = jnp.where(has_zero > 0, 0.0, sign * mag)
-        if jnp.issubdtype(x.dtype, jnp.integer):
-            out = jnp.round(out)
-        out = out.astype(x.dtype)
+        # exact elementwise product: gather the n shards and multiply in
+        # the input dtype (an exp/log round-trip is inexact for ints
+        # beyond 2^24 and for low-precision floats; c_allreduce_prod is an
+        # exact product)
+        g = jax.lax.all_gather(x, axis)
+        out = jnp.prod(g, axis=0).astype(x.dtype)
     else:
         raise ValueError(f"unknown reduce op {op}")
     if isinstance(tensor, Tensor):

@@ -7,7 +7,7 @@ TP layers' collectives (mp_layers.py), PipelineParallel's 1F1B tick loop
 bookkeeping (group_sharded_optimizer_stage2.py:48), HybridParallelClipGrad
 (hybrid_parallel_optimizer.py:45) and the DDP grad sync — executed not by
 four Python wrapper classes over NCCL but by ONE shard_map'd train step over
-a 5-axis mesh ("dp","pp","sharding","sep","mp") whose collectives XLA
+a 6-axis mesh ("dp","pp","sharding","sep","ep","mp") whose collectives XLA
 schedules on ICI.
 
 Manual-SPMD design (vs GSPMD auto-sharding) is deliberate: the Pallas flash
@@ -357,6 +357,21 @@ class HybridEngine:
         mask = (labels != -100).astype(jnp.float32)
         return (loss_tok * mask).sum(), mask.sum()
 
+    def _aux_mean(self, aux):
+        """Reduce a per-shard MoE aux loss to the global batch value: SUM
+        over pp (stages partition the layers) and MEAN over the data/seq
+        shards (each gates a disjoint token slice), matching gpt_loss's
+        full-batch aux (models/gpt.py:270-273)."""
+        vma = jax.typeof(aux).vma
+        total = _psum_varying(aux)
+        denom = 1
+        for name, size in (("dp", self.dp), ("sharding", self.zr),
+                           ("ep", self.ep), ("sep", self.sep),
+                           ("mp", self.mp)):
+            if name in vma:
+                denom *= size
+        return total / denom
+
     # ---------------------------------------------------------- loss (SPMD)
     def _local_loss(self, params, tokens, labels):
         """Per-device loss: pipeline over pp, everything else TP/SP local."""
@@ -368,10 +383,14 @@ class HybridEngine:
         mb = b // num_micro
 
         if pp == 1:
-            out = self._stage(params["blocks"], x)
+            out, aux = self._stage(params["blocks"], x)
             s, c = self._loss_head(params, out, labels)
             total = _psum_varying(jnp.stack([s, c]))
-            return total[0] / jnp.maximum(total[1], 1.0)
+            loss = total[0] / jnp.maximum(total[1], 1.0)
+            if cfg.moe_experts:
+                loss = loss + cfg.moe_aux_weight * self._aux_mean(aux) \
+                    / cfg.num_layers
+            return loss
 
         # ---- pipeline ticks (GPipe-fill then drain; backward is the AD
         # transpose of the ppermute ring = reverse pipeline) ----
@@ -382,10 +401,16 @@ class HybridEngine:
         fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
 
         def tick(carry, t):
-            state, loss_sum, cnt_sum = carry
+            state, loss_sum, cnt_sum, aux_sum = carry
             inp = x_mb[jnp.clip(t, 0, num_micro - 1)]
             state = jnp.where(pp_idx == 0, inp, state)
-            y = self._stage(params["blocks"], state)
+            y, aux = self._stage(params["blocks"], state)
+            # a stage holds REAL data at tick t iff pp_idx <= t < pp_idx +
+            # num_micro; bubble ticks compute on garbage and must not feed
+            # the MoE aux loss
+            is_live = ((t >= pp_idx) &
+                       (t - pp_idx < num_micro)).astype(jnp.float32)
+            aux_sum = aux_sum + aux * is_live
             m = t - (pp - 1)
             # where-gate (not lax.cond): all devices run the loss head so the
             # vma types stay uniform across ticks; XLA selects per device
@@ -395,7 +420,7 @@ class HybridEngine:
             loss_sum = loss_sum + s * is_out
             cnt_sum = cnt_sum + c * is_out
             state = jax.lax.ppermute(y, "pp", fwd_perm)
-            return (state, loss_sum, cnt_sum), None
+            return (state, loss_sum, cnt_sum, aux_sum), None
 
         # carry init must already have the vma the loop body produces
         # (scan requires fixed carry avals; pvary lifts the zeros)
@@ -403,10 +428,16 @@ class HybridEngine:
         pvary = lambda v: jax.lax.pcast(v, carry_axes, to="varying")
         state0 = pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype))
         zero = lambda: pvary(jnp.zeros((), jnp.float32))
-        (state, loss_sum, cnt_sum), _ = jax.lax.scan(
-            tick, (state0, zero(), zero()), jnp.arange(num_ticks))
+        (state, loss_sum, cnt_sum, aux_sum), _ = jax.lax.scan(
+            tick, (state0, zero(), zero(), zero()), jnp.arange(num_ticks))
         total = _psum_varying(jnp.stack([loss_sum, cnt_sum]))
-        return total[0] / jnp.maximum(total[1], 1.0)
+        loss = total[0] / jnp.maximum(total[1], 1.0)
+        if cfg.moe_experts:
+            # aux_sum holds num_micro full passes over the layers: psum over
+            # pp collects the stages, /num_micro averages the microbatches
+            loss = loss + cfg.moe_aux_weight \
+                * (self._aux_mean(aux_sum) / num_micro) / cfg.num_layers
+        return loss
 
     # ------------------------------------------------------------- the step
     def _step_local(self, params, opt_state, tokens, labels, lr):

@@ -70,11 +70,12 @@ _AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
              "sep": "sep", "expert": "ep"}
 
 
-def build_mesh(dp=1, pp=1, sharding=1, mp=1, sep=1, ep=1, devices=None):
+def build_mesh(*, dp=1, pp=1, sharding=1, sep=1, ep=1, mp=1, devices=None):
     """Build the jax Mesh with the canonical axis order.  Total must equal
     len(devices).  Axes of size 1 are kept (zero-cost) so shardings can
     always name them.  "ep" (expert parallel) sits just outside "mp" so the
-    MoE all_to_all rides nearest-neighbor ICI links."""
+    MoE all_to_all rides nearest-neighbor ICI links.  Keyword-only: the
+    degrees must be named so no caller can depend on positional order."""
     devices = np.asarray(devices if devices is not None else jax.devices())
     shape = (dp, pp, sharding, sep, ep, mp)
     if int(np.prod(shape)) != devices.size:
@@ -108,9 +109,9 @@ class HybridCommunicateGroup:
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
         self._ep_degree = ep_degree
-        self.mesh = build_mesh(dp_degree, pp_degree, sharding_degree,
-                               mp_degree, sep_degree, ep_degree,
-                               devices=devices)
+        self.mesh = build_mesh(dp=dp_degree, pp=pp_degree,
+                               sharding=sharding_degree, sep=sep_degree,
+                               ep=ep_degree, mp=mp_degree, devices=devices)
         self._groups = {
             "dp": Group(axis_name="dp", gid=1),
             "pp": Group(axis_name="pp", gid=2),
