@@ -76,8 +76,8 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        if not self._unscaled and (self._use_dynamic or self._scale != 1.0):
-            self.unscale_(optimizer)
+        if not self._unscaled:
+            self.unscale_(optimizer)  # no-ops itself when scaling is off
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
