@@ -75,7 +75,17 @@ class EngineConfig:
     weight_decay: float = 0.1
     grad_clip: float = 1.0
     num_microbatches: int = 1       # pipeline microbatches (must be >= pp)
+    # ZeRO stage over the "sharding" axis (reference: group_sharded_stage2/3):
+    #   2 — optimizer state + grads sharded, bf16 params replicated (the
+    #       reduce-scatter + param-allgather path)
+    #   3 — additionally shard the params themselves; each block's weights
+    #       are all_gather'd just-in-time inside the (rematted) layer scan
+    #       and re-gathered in backward (group_sharded_stage3.py:58)
     zero_stage: int = 2
+    # gradient accumulation (reference: gradient_merge_optimizer): split the
+    #   batch into accum_steps micro-batches, run fwd/bwd per chunk under a
+    #   lax.scan, average the fp32 grads, then apply ONE optimizer step
+    accum_steps: int = 1
 
 
 class HybridEngine:
@@ -100,6 +110,12 @@ class HybridEngine:
             assert cfg.moe_experts > 0, "ep>1 needs a MoE model"
         if cfg.moe_experts:
             assert cfg.moe_experts % ep == 0, "experts must divide ep"
+        if self.ec.zero_stage >= 3 and sharding > 1:
+            assert cfg.hidden % sharding == 0, \
+                "ZeRO-3 shards the hidden dim: hidden %% sharding == 0"
+            if cfg.moe_experts:
+                assert cfg.ffn_hidden % sharding == 0, \
+                    "ZeRO-3 MoE shards ffn_hidden over 'sharding'"
         self.mesh = mesh if mesh is not None else build_mesh(
             dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp, ep=ep,
             devices=devices)
@@ -108,11 +124,14 @@ class HybridEngine:
     # ------------------------------------------------------------ shardings
     def param_specs(self):
         """Manual-mode layout: blocks pp-sharded on the layer axis, Megatron
-        column/row splits on mp, everything else replicated."""
+        column/row splits on mp, everything else replicated.  ZeRO-3
+        additionally shards each matrix leaf's free dim over 'sharding'
+        (small vectors stay replicated — stage-2 handles their opt state)."""
+        z = "sharding" if self.ec.zero_stage >= 3 and self.zr > 1 else None
         blocks = {
             "ln1_g": P("pp", None), "ln1_b": P("pp", None),
-            "qkv_w": P("pp", None, "mp"), "qkv_b": P("pp", "mp"),
-            "proj_w": P("pp", "mp", None), "proj_b": P("pp", None),
+            "qkv_w": P("pp", z, "mp"), "qkv_b": P("pp", "mp"),
+            "proj_w": P("pp", "mp", z), "proj_b": P("pp", None),
             "ln2_g": P("pp", None), "ln2_b": P("pp", None),
         }
         if self.cfg.moe_experts:
@@ -120,21 +139,53 @@ class HybridEngine:
             # inner dim stays unsharded (ep takes mp's role for the FFN)
             blocks.update({
                 "gate_w": P("pp", None, None),
-                "up_w": P("pp", "ep", None, None), "up_b": P("pp", "ep", None),
-                "down_w": P("pp", "ep", None, None),
+                "up_w": P("pp", "ep", z, None), "up_b": P("pp", "ep", None),
+                "down_w": P("pp", "ep", z, None),
                 "down_b": P("pp", "ep", None),
             })
         else:
             blocks.update({
-                "up_w": P("pp", None, "mp"), "up_b": P("pp", "mp"),
-                "down_w": P("pp", "mp", None), "down_b": P("pp", None),
+                "up_w": P("pp", z, "mp"), "up_b": P("pp", "mp"),
+                "down_w": P("pp", "mp", z), "down_b": P("pp", None),
             })
         return {
-            "wte": P("mp", None),                     # vocab-parallel
+            "wte": P("mp", z),                        # vocab-parallel
             "wpe": P(None, None),
             "blocks": blocks,
             "lnf_g": P(None), "lnf_b": P(None),
         }
+
+    # ----------------------------------------------------- ZeRO-3 gathering
+    def _z3(self):
+        return self.ec.zero_stage >= 3 and self.zr > 1
+
+    @staticmethod
+    def _z3_gather_leaf(x, spec, skip_leading=0):
+        """all_gather ``x`` along the dim its spec shards over 'sharding'.
+        ``skip_leading`` drops leading spec entries already consumed (the
+        scan eats the pp-stacked layer dim)."""
+        for i, entry in enumerate(tuple(spec)[skip_leading:]):
+            names = entry if isinstance(entry, (tuple, list)) else (entry,)
+            if "sharding" in names:
+                return jax.lax.all_gather(x, "sharding", axis=i, tiled=True)
+        return x
+
+    def _z3_gather_block(self, bp):
+        """JIT param gather for one block (stage-3 pre-forward allgather,
+        group_sharded_stage3.py semantics).  Runs INSIDE the remat so
+        backward re-gathers instead of keeping full params live."""
+        if not self._z3():
+            return bp
+        specs = self.param_specs()["blocks"]
+        return {k: self._z3_gather_leaf(v, specs[k], skip_leading=1)
+                for k, v in bp.items()}
+
+    def _wte(self, params):
+        """wte with the stage-3 shard gathered (embed + loss head)."""
+        wte = params["wte"]
+        if self._z3():
+            wte = self._z3_gather_leaf(wte, self.param_specs()["wte"])
+        return wte
 
     def _opt_chunk(self, leaf_shape, dtype=jnp.float32):
         n = int(np.prod(leaf_shape))
@@ -201,8 +252,16 @@ class HybridEngine:
         specs = self.param_specs()
 
         def init_local(params_local):
-            def build(p_local):
+            def build(p_local, spec):
                 n = int(np.prod(p_local.shape))
+                if self._z3() and "sharding" in self._leaf_axes(spec):
+                    # stage-3 leaf: the local param IS this rank's shard —
+                    # its flat value is the master chunk as-is (already
+                    # sharding-varying, matching the opt spec)
+                    z = jnp.zeros((1, 1, 1, n), jnp.float32)
+                    return {"m": z, "v": z,
+                            "master": p_local.reshape(1, 1, 1, n)
+                                             .astype(jnp.float32)}
                 chunk = -(-n // zr)
                 flat = jnp.pad(p_local.reshape(-1).astype(jnp.float32),
                                (0, zr * chunk - n))
@@ -216,7 +275,7 @@ class HybridEngine:
                 return {"m": z, "v": z,
                         "master": mine.reshape(1, 1, 1, chunk)}
 
-            return jax.tree_util.tree_map(build, params_local)
+            return jax.tree_util.tree_map(build, params_local, specs)
 
         slots_specs = jax.tree_util.tree_map(
             self._opt_leaf_spec, specs, is_leaf=lambda x: isinstance(x, P))
@@ -230,7 +289,7 @@ class HybridEngine:
         """Vocab-parallel embedding + position embedding.
         tokens: [b, s_local]; wte local: [V/mp, D]."""
         cfg, mp, sep = self.cfg, self.mp, self.sep
-        wte = params["wte"]
+        wte = self._wte(params)
         vpp = cfg.vocab_size // mp
         mp_idx = jax.lax.axis_index("mp") if mp > 1 else 0
         local_ids = tokens - mp_idx * vpp
@@ -320,7 +379,7 @@ class HybridEngine:
         Returns (x, aux_sum) — the stage's summed MoE aux loss."""
         from .recompute import checkpoint_policy
 
-        block_fn = lambda bp, x: self._block(bp, x)
+        block_fn = lambda bp, x: self._block(self._z3_gather_block(bp), x)
         if self.cfg.remat != "nothing":
             block_fn = jax.checkpoint(
                 block_fn, policy=checkpoint_policy(self.cfg.remat),
@@ -347,7 +406,8 @@ class HybridEngine:
         from .mp_layers import parallel_cross_entropy
 
         x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-        logits = jnp.einsum("bsd,vd->bsv", x, params["wte"]).astype(jnp.float32)
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            self._wte(params)).astype(jnp.float32)
         if mp > 1:
             loss_tok = parallel_cross_entropy(logits, labels, mp_axis="mp")
         else:
@@ -442,35 +502,78 @@ class HybridEngine:
     # ------------------------------------------------------------- the step
     def _step_local(self, params, opt_state, tokens, labels, lr):
         ec, zr = self.ec, self.zr
-        loss, grads = jax.value_and_grad(self._local_loss)(
-            params, tokens, labels)
+        accum = ec.accum_steps
+        grad_fn = jax.value_and_grad(self._local_loss)
 
-        flat_g, treedef = jax.tree_util.tree_flatten(grads)
-        flat_p = treedef.flatten_up_to(params)
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_slots = treedef.flatten_up_to(opt_state["slots"])
+        flat_specs = treedef.flatten_up_to(self.param_specs())
         paths = [
             "/".join(str(getattr(k, "key", k)) for k in kp)
-            for kp, _ in jax.tree_util.tree_flatten_with_path(grads)[0]
+            for kp, _ in jax.tree_util.tree_flatten_with_path(params)[0]
         ]
+        zr_idx = jax.lax.axis_index("sharding")
+        z3_leaf = [self._z3() and "sharding" in self._leaf_axes(s)
+                   for s in flat_specs]
+
+        def to_chunks(grads):
+            """ZeRO chunking per leaf.
+
+            check_vma AD already psum'd every grad over the axes its param
+            is replicated on — the vma type of each grad equals its
+            param's.  Each rank keeps its own 1/zr chunk; XLA's
+            reduce-scatter-creator fuses the AD all-reduce with this slice
+            into a reduce_scatter over 'sharding'.  stage-3 leaves arrive
+            already reduce-scattered (the all_gather transpose)."""
+            flat_g = treedef.flatten_up_to(grads)
+            chunks = []
+            for g, z3 in zip(flat_g, z3_leaf):
+                if z3:
+                    chunks.append(g.reshape(-1).astype(jnp.float32))
+                    continue
+                n = int(np.prod(g.shape))
+                chunk = -(-n // zr)
+                gf = jnp.pad(g.reshape(-1).astype(jnp.float32),
+                             (0, zr * chunk - n))
+                chunks.append(jax.lax.dynamic_slice_in_dim(
+                    gf.reshape(zr, chunk), zr_idx, 1, axis=0)[0])
+            return chunks
+
+        if accum == 1:
+            loss, grads = grad_fn(params, tokens, labels)
+            g_chunks = to_chunks(grads)
+        else:
+            # gradient merge (reference: gradient_merge_optimizer): scan
+            # accum chunks of the local batch.  The carry holds only each
+            # rank's 1/zr grad chunks, so per-iteration comm stays a
+            # reduce_scatter and grad memory stays ZeRO-sharded.
+            b = tokens.shape[0]
+            assert b % accum == 0, "local batch must divide accum_steps"
+            tok = tokens.reshape(accum, b // accum, tokens.shape[1])
+            lab = labels.reshape(accum, b // accum, labels.shape[1])
+
+            def acc_body(carry, xs):
+                loss_sum, gsum = carry
+                l, g = grad_fn(params, xs[0], xs[1])
+                gc = to_chunks(g)
+                return (loss_sum + l,
+                        tuple(a + c for a, c in zip(gsum, gc))), None
+
+            def chunk_zero(p, z3):
+                n = int(np.prod(p.shape))
+                size = n if z3 else -(-n // zr)
+                vma = tuple(sorted(set(jax.typeof(p).vma) | {"sharding"}))
+                return jax.lax.pcast(jnp.zeros((size,), jnp.float32), vma,
+                                     to="varying")
+
+            g0 = tuple(chunk_zero(p, z3)
+                       for p, z3 in zip(flat_p, z3_leaf))
+            (loss_sum, g_chunks), _ = jax.lax.scan(
+                acc_body, (jnp.zeros((), jnp.float32), g0), (tok, lab))
+            loss = loss_sum / accum
+            g_chunks = [g / accum for g in g_chunks]
 
         step = opt_state["step"] + 1
-
-        # --- ZeRO chunking per leaf ---
-        # check_vma AD already psum'd every grad over the axes its param is
-        # replicated on (dp/sharding/sep/pp/mp as appropriate) — the vma
-        # type of each grad equals its param's.  Each rank keeps its own
-        # 1/zr chunk; XLA's reduce-scatter-creator fuses the AD all-reduce
-        # with this slice into a reduce_scatter over 'sharding'.
-        zr_idx = jax.lax.axis_index("sharding")
-        g_chunks = []
-        for path, g in zip(paths, flat_g):
-            n = int(np.prod(g.shape))
-            chunk = -(-n // zr)
-            gf = jnp.pad(g.reshape(-1).astype(jnp.float32),
-                         (0, zr * chunk - n))
-            gc = jax.lax.dynamic_slice_in_dim(
-                gf.reshape(zr, chunk), zr_idx, 1, axis=0)[0]
-            g_chunks.append(gc)
 
         # --- global-norm clip over the sharded chunks ---
         # per-leaf vma-aware reduce: an mp-sharded leaf's chunks must be
@@ -489,7 +592,8 @@ class HybridEngine:
         new_flat_p, new_flat_slots = [], []
         b1, b2 = ec.beta1, ec.beta2
         stepf = step.astype(jnp.float32)
-        for path, p, slots, g in zip(paths, flat_p, flat_slots, g_chunks):
+        for path, p, slots, g, z3 in zip(paths, flat_p, flat_slots, g_chunks,
+                                         z3_leaf):
             m_loc = slots["m"][0, 0, 0]          # [chunk]
             v_loc = slots["v"][0, 0, 0]
             w_loc = slots["master"][0, 0, 0]
@@ -503,15 +607,21 @@ class HybridEngine:
                     not path.endswith("_b"):
                 upd = upd + decay * w_loc
             w_new = w_loc - lr * upd
-            # rebuild the full fp32 param: scatter own chunk into zeros and
-            # psum over 'sharding' (psum is the only varying→invariant cast,
-            # so this is the type-correct all_gather; also identity at zr==1)
-            full = jnp.zeros((zr * w_new.shape[0],), jnp.float32)
-            full = jax.lax.dynamic_update_slice(
-                full, w_new, (zr_idx * w_new.shape[0],))
-            full = jax.lax.psum(full, "sharding")
-            n = int(np.prod(p.shape))
-            new_p = full[:n].reshape(p.shape).astype(p.dtype)
+            if z3:
+                # stage-3: the param stays sharded — the updated chunk IS
+                # the new local param (no allgather; the forward gathers JIT)
+                new_p = w_new.reshape(p.shape).astype(p.dtype)
+            else:
+                # rebuild the full fp32 param: scatter own chunk into zeros
+                # and psum over 'sharding' (psum is the only
+                # varying→invariant cast, so this is the type-correct
+                # all_gather; also identity at zr==1)
+                full = jnp.zeros((zr * w_new.shape[0],), jnp.float32)
+                full = jax.lax.dynamic_update_slice(
+                    full, w_new, (zr_idx * w_new.shape[0],))
+                full = jax.lax.psum(full, "sharding")
+                n = int(np.prod(p.shape))
+                new_p = full[:n].reshape(p.shape).astype(p.dtype)
             new_flat_p.append(new_p)
             shape4 = slots["m"].shape
             new_flat_slots.append({

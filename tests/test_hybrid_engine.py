@@ -103,6 +103,65 @@ def test_full_4axis(baseline):
     _assert_close(losses, baseline[0])
 
 
+def test_zero3_matches(baseline):
+    eng = HybridEngine(CFG, sharding=4, devices=jax.devices()[:4],
+                       engine_cfg=EngineConfig(zero_stage=3))
+    losses, _ = _run_steps(eng)
+    _assert_close(losses, baseline[0])
+
+
+def test_zero3_hybrid_matches(baseline):
+    eng = HybridEngine(CFG, dp=2, sharding=2, mp=2,
+                       engine_cfg=EngineConfig(zero_stage=3))
+    losses, _ = _run_steps(eng)
+    _assert_close(losses, baseline[0])
+
+
+def test_zero3_persistent_memory_smaller():
+    """Stage-3 must hold strictly less persistent state per device than
+    stage-2 (params sharded, not just opt state) — the HBM assertion from
+    the reference's group_sharded_stage3 contract."""
+    def device0_bytes(engine):
+        params, opt = engine.init(seed=0)
+        total = 0
+        for leaf in (jax.tree_util.tree_leaves(params) +
+                     jax.tree_util.tree_leaves(opt)):
+            total += leaf.addressable_shards[0].data.nbytes
+        return total
+
+    devs = jax.devices()[:4]
+    b2 = device0_bytes(HybridEngine(CFG, sharding=4, devices=devs,
+                                    engine_cfg=EngineConfig(zero_stage=2)))
+    b3 = device0_bytes(HybridEngine(CFG, sharding=4, devices=devs,
+                                    engine_cfg=EngineConfig(zero_stage=3)))
+    # opt state is sharded in both (3/7 of the f32 footprint per param);
+    # stage-3 shards the working params too, taking a matrix leaf from
+    # (4+3)/7 to (1+3)/7 ≈ 0.57 — small replicated leaves add a little
+    assert b3 < 0.65 * b2, (b3, b2)
+
+
+def test_zero3_param_leaves_sharded():
+    eng = HybridEngine(CFG, sharding=4, devices=jax.devices()[:4],
+                       engine_cfg=EngineConfig(zero_stage=3))
+    params, _ = eng.init(seed=0)
+    qkv = params["blocks"]["qkv_w"]
+    assert qkv.addressable_shards[0].data.size * 4 == qkv.size
+
+
+def test_grad_accum_matches(baseline):
+    eng = HybridEngine(CFG, devices=jax.devices()[:1],
+                       engine_cfg=EngineConfig(accum_steps=4))
+    losses, _ = _run_steps(eng)
+    _assert_close(losses, baseline[0])
+
+
+def test_grad_accum_hybrid_matches(baseline):
+    eng = HybridEngine(CFG, dp=2, sharding=2, mp=2,
+                       engine_cfg=EngineConfig(accum_steps=2, zero_stage=3))
+    losses, _ = _run_steps(eng)
+    _assert_close(losses, baseline[0])
+
+
 def test_params_stay_synced(baseline):
     _, base_params = baseline
     eng = HybridEngine(CFG, dp=2, mp=2, sharding=2)

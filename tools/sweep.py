@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Perf sweep on the real chip: batch size x remat policy x sync mode.
+
+Prints one line per config; used to pick bench.py's default config.
+"""
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def run_config(batch, remat, flash, async_steps, steps=10, warmup=2,
+               seq=1024, accum=1):
+    import jax
+
+    from paddle_tpu.distributed.engine import EngineConfig, HybridEngine
+    from paddle_tpu.models.gpt import GPT_CONFIGS
+
+    cfg = dataclasses.replace(GPT_CONFIGS["gpt2-medium"], use_flash=flash,
+                              remat=remat, dtype="bfloat16")
+    eng = HybridEngine(cfg, devices=jax.devices()[:1],
+                       engine_cfg=EngineConfig(accum_steps=accum))
+    params, opt = eng.init(seed=0)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.concatenate(
+        [tokens[:, 1:], np.full((batch, 1), -100)], 1).astype(np.int32)
+
+    t0 = time.perf_counter()
+    params, opt, loss = eng.step(params, opt, tokens, labels)
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t0
+
+    for _ in range(warmup):
+        params, opt, loss = eng.step(params, opt, tokens, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    if async_steps:
+        for _ in range(steps):
+            params, opt, loss = eng.step(params, opt, tokens, labels)
+        jax.block_until_ready(loss)
+    else:
+        for _ in range(steps):
+            params, opt, loss = eng.step(params, opt, tokens, labels)
+            jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = batch * seq / dt
+    mfu = tok_s * (6 * 355e6 + 6 * 24 * seq * 1024) / 197e12
+    log(f"bs={batch:3d} remat={remat:8s} flash={int(flash)} "
+        f"async={int(async_steps)} accum={accum}: {dt*1e3:7.1f} ms/step "
+        f"{tok_s:8.0f} tok/s mfu={mfu*100:.1f}% (compile {compile_s:.0f}s)")
+    del params, opt
+    return tok_s
+
+
+if __name__ == "__main__":
+    import jax
+
+    log(f"devices={jax.devices()}")
+    configs = [
+        dict(batch=8, remat="dots", flash=True, async_steps=False),
+        dict(batch=8, remat="dots", flash=True, async_steps=True),
+        dict(batch=8, remat="nothing", flash=True, async_steps=True),
+        dict(batch=16, remat="dots", flash=True, async_steps=True),
+        dict(batch=16, remat="nothing", flash=True, async_steps=True),
+        dict(batch=32, remat="dots", flash=True, async_steps=True),
+        dict(batch=16, remat="dots", flash=False, async_steps=True),
+    ]
+    for c in configs:
+        try:
+            run_config(**c)
+        except Exception as e:
+            log(f"{c}: FAILED {str(e)[:150]}")
