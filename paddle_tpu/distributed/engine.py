@@ -398,16 +398,21 @@ class HybridEngine:
         (out, aux_sum), _ = jax.lax.scan(body, (x, aux0), blocks_local)
         return out, aux_sum
 
-    def _loss_head(self, params, x, labels):
+    def _head_params(self, params):
+        """The loss head's own params (wte stage-3 pre-gathered)."""
+        return {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
+                "wte": self._wte(params)}
+
+    def _loss_head(self, hp, x, labels):
         """Final LN + tied-embedding logits + vocab-parallel CE.
-        x: [b, s_local, D]; labels: [b, s_local]. Returns (sum_loss, count)."""
+        hp: head params (see _head_params); x: [b, s_local, D];
+        labels: [b, s_local]. Returns (sum_loss, count)."""
         cfg, mp = self.cfg, self.mp
         from ..models.gpt import _layer_norm
         from .mp_layers import parallel_cross_entropy
 
-        x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-        logits = jnp.einsum("bsd,vd->bsv", x,
-                            self._wte(params)).astype(jnp.float32)
+        x = _layer_norm(x, hp["lnf_g"], hp["lnf_b"])
+        logits = jnp.einsum("bsd,vd->bsv", x, hp["wte"]).astype(jnp.float32)
         if mp > 1:
             loss_tok = parallel_cross_entropy(logits, labels, mp_axis="mp")
         else:
@@ -444,7 +449,7 @@ class HybridEngine:
 
         if pp == 1:
             out, aux = self._stage(params["blocks"], x)
-            s, c = self._loss_head(params, out, labels)
+            s, c = self._loss_head(self._head_params(params), out, labels)
             total = _psum_varying(jnp.stack([s, c]))
             loss = total[0] / jnp.maximum(total[1], 1.0)
             if cfg.moe_experts:
@@ -460,34 +465,63 @@ class HybridEngine:
         num_ticks = num_micro + pp - 1
         fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
 
+        # carry init must already have the vma the loop body produces
+        # (scan requires fixed carry avals; pvary lifts the zeros)
+        carry_axes = tuple(sorted(set(jax.typeof(x).vma) | {"pp"}))
+
+        def lift(v):
+            """pcast v up to the carry's vma (cond branches must agree on
+            the varying-axis type; values like label-derived counts lack
+            pp/mp while stage outputs carry them)."""
+            missing = tuple(a for a in carry_axes
+                            if a not in jax.typeof(v).vma)
+            return jax.lax.pcast(v, missing, to="varying") if missing else v
+
+        state0 = lift(jnp.zeros((mb,) + x.shape[1:], x.dtype))
+        zero = lambda: lift(jnp.zeros((), jnp.float32))
+        # CRITICAL: every pp-invariant value consumed INSIDE a cond branch
+        # must be lifted to pp-varying OUT HERE — otherwise AD places the
+        # de-varying psum over 'pp' inside the branch, where only the live
+        # stages execute it → collective mismatch at runtime.  Lifting
+        # outside puts the transpose psum on the all-ranks path.
+        hp = jax.tree_util.tree_map(lift, self._head_params(params))
+        lab_mb_l = lift(lab_mb)
+
         def tick(carry, t):
             state, loss_sum, cnt_sum, aux_sum = carry
             inp = x_mb[jnp.clip(t, 0, num_micro - 1)]
             state = jnp.where(pp_idx == 0, inp, state)
-            y, aux = self._stage(params["blocks"], state)
             # a stage holds REAL data at tick t iff pp_idx <= t < pp_idx +
-            # num_micro; bubble ticks compute on garbage and must not feed
-            # the MoE aux loss
-            is_live = ((t >= pp_idx) &
-                       (t - pp_idx < num_micro)).astype(jnp.float32)
-            aux_sum = aux_sum + aux * is_live
+            # num_micro.  Bubble ticks SKIP the stage via lax.cond — legal
+            # because the predicate varies only over 'pp', so every member
+            # of an mp/sep/ep group takes the same branch and the TP
+            # collectives inside the stage stay collective-safe.  This is
+            # the fill-drain schedule's bubble compute, eliminated.
+            is_live = (t >= pp_idx) & (t - pp_idx < num_micro)
+
+            def live_stage(s):
+                ys, a = self._stage(params["blocks"], s)
+                return lift(ys), lift(a)
+
+            y, aux = jax.lax.cond(
+                is_live, live_stage, lambda s: (lift(s), zero()), state)
+            aux_sum = aux_sum + aux
             m = t - (pp - 1)
-            # where-gate (not lax.cond): all devices run the loss head so the
-            # vma types stay uniform across ticks; XLA selects per device
-            is_out = ((pp_idx == pp - 1) & (m >= 0)).astype(jnp.float32)
-            lab = lab_mb[jnp.clip(m, 0, num_micro - 1)]
-            s, c = self._loss_head(params, y, lab)
-            loss_sum = loss_sum + s * is_out
-            cnt_sum = cnt_sum + c * is_out
+            # the vocab-sized loss head runs ONLY on the last stage's live
+            # output ticks (same pp-only-varying predicate argument)
+            is_out = (pp_idx == pp - 1) & (m >= 0)
+            lab = lab_mb_l[jnp.clip(m, 0, num_micro - 1)]
+
+            def live_head(yy, ll):
+                s_, c_ = self._loss_head(hp, yy, ll)
+                return lift(s_), lift(c_)
+
+            s, c = jax.lax.cond(
+                is_out, live_head, lambda yy, ll: (zero(), zero()), y, lab)
+            loss_sum = loss_sum + s
+            cnt_sum = cnt_sum + c
             state = jax.lax.ppermute(y, "pp", fwd_perm)
             return (state, loss_sum, cnt_sum, aux_sum), None
-
-        # carry init must already have the vma the loop body produces
-        # (scan requires fixed carry avals; pvary lifts the zeros)
-        carry_axes = tuple(sorted(set(jax.typeof(x).vma) | {"pp"}))
-        pvary = lambda v: jax.lax.pcast(v, carry_axes, to="varying")
-        state0 = pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype))
-        zero = lambda: pvary(jnp.zeros((), jnp.float32))
         (state, loss_sum, cnt_sum, aux_sum), _ = jax.lax.scan(
             tick, (state0, zero(), zero(), zero()), jnp.arange(num_ticks))
         total = _psum_varying(jnp.stack([loss_sum, cnt_sum]))

@@ -1,0 +1,352 @@
+"""Pipeline-parallel user API: LayerDesc / SharedLayerDesc / SegmentLayers /
+PipelineLayer + an SPMD PipelineEngine for arbitrary Layer lists.
+
+Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py —
+``LayerDesc`` (:58), ``SharedLayerDesc`` (:76), ``SegmentLayers`` (:90),
+``PipelineLayer`` (:159) — and pipeline_parallel.py's train_batch loop.
+
+TPU-first redesign: the reference assigns each rank its own stage's
+sub-layers and streams activations over NCCL p2p.  Under XLA SPMD every
+device must run ONE program, so heterogeneous stages are expressed as a
+``lax.switch`` over per-stage apply functions with a fixed-size flattened
+activation carry; the schedule is the same lockstep tick scan as the
+hybrid engine's (ppermute ring, fill-drain with lax.cond bubble-skipping —
+AD transposes it into the reverse pipeline, giving 1F1B's work pattern
+with activation liveness bounded by per-tick remat instead of manual
+schedule bookkeeping).
+
+Trade-off (documented, deliberate): stage params are replicated across pp
+ranks — predicated dispatch needs every rank to hold every branch's
+operands.  For homogeneous transformer stacks use HybridEngine, whose
+stacked-block layout shards params over 'pp'; PipelineLayer is the
+API-parity path for arbitrary heterogeneous Layer lists (the reference's
+AlexNet-style pp tests).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
+           "PipelineEngine"]
+
+
+class LayerDesc:
+    """Lazy layer constructor (reference pp_layers.py:58)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle_tpu.nn.Layer")
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A LayerDesc whose parameters are SHARED with every other desc that
+    names the same ``key`` (reference pp_layers.py:76 — tied embeddings).
+    ``forward_func(layer, x)`` overrides the call when the shared layer is
+    reused in a different role (e.g. embedding matrix as output proj)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+class SegmentLayers:
+    """Partition N layers into num_parts contiguous stages
+    (reference pp_layers.py:90): 'uniform' balances layer count,
+    'parameter' balances parameter count."""
+
+    def __init__(self, layers, num_parts, method="uniform"):
+        self.layers = layers
+        self.num_parts = num_parts
+        self.method = method
+        assert num_parts >= 1
+        assert len(layers) >= num_parts, "need at least one layer per stage"
+
+    def do_segment(self):
+        n = len(self.layers)
+        if self.method == "uniform":
+            weights = [1] * n
+        elif self.method in ("parameter", "param"):
+            weights = []
+            for l in self.layers:
+                cnt = sum(int(np.prod(p.shape))
+                          for _, p in l.named_parameters()) or 1
+                weights.append(cnt)
+        else:
+            raise ValueError(f"unknown seg_method {self.method}")
+        # greedy prefix split minimizing the max-stage weight
+        total = sum(weights)
+        bounds = [0]
+        acc = 0
+        target = total / self.num_parts
+        for i, w in enumerate(weights):
+            acc += w
+            if (acc >= target * len(bounds)
+                    and len(bounds) < self.num_parts
+                    and n - (i + 1) >= self.num_parts - len(bounds)):
+                bounds.append(i + 1)
+        while len(bounds) < self.num_parts:
+            bounds.append(n - (self.num_parts - len(bounds)))
+        bounds.append(n)
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """The user-facing container (reference pp_layers.py:159).
+
+    layers: list of Layer / LayerDesc / SharedLayerDesc.
+    Works as a plain sequential Layer on one device; hand it to
+    ``PipelineEngine`` to train pipeline-parallel.
+    """
+
+    def __init__(self, layers, num_stages=2, loss_fn=None,
+                 seg_method="uniform", topology=None):
+        super().__init__()
+        if topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self._shared = {}       # key -> built Layer
+        self._forward_funcs = []
+        built = []
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                if d.key not in self._shared:
+                    self._shared[d.key] = d.build_layer()
+                built.append(self._shared[d.key])
+                self._forward_funcs.append(d.forward_func)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+                self._forward_funcs.append(None)
+            elif isinstance(d, Layer):
+                built.append(d)
+                self._forward_funcs.append(None)
+            else:
+                raise TypeError(f"cannot stage {type(d)}")
+        self.run_funcs = built
+        for i, l in enumerate(built):
+            setattr(self, f"_seg{i}", l)   # register as sublayer
+        self._bounds = SegmentLayers(built, num_stages, seg_method).do_segment()
+
+    def segment_bounds(self):
+        return list(self._bounds)
+
+    def stage_layers(self, stage):
+        lo, hi = self._bounds[stage], self._bounds[stage + 1]
+        return list(zip(self.run_funcs[lo:hi], self._forward_funcs[lo:hi]))
+
+    def forward(self, x):
+        for layer, ff in zip(self.run_funcs, self._forward_funcs):
+            x = ff(layer, x) if ff is not None else layer(x)
+        return x
+
+
+class PipelineEngine:
+    """SPMD trainer for a PipelineLayer over a 1-D 'pp' mesh.
+
+    The tick loop mirrors the hybrid engine's pipeline (same fill-drain +
+    lax.cond bubble-skip + ppermute ring); heterogeneous stages run under
+    lax.switch with a zero-padded flat activation carry whose width is the
+    max per-sample activation across stage boundaries (the SPMD stand-in
+    for the reference's SendRecvMeta shape negotiation).
+    """
+
+    def __init__(self, pipeline: PipelineLayer, num_microbatches=2,
+                 lr=1e-3, optimizer="sgd", devices=None, sample_input=None):
+        self.pl = pipeline
+        self.pp = pipeline.num_stages
+        self.num_micro = num_microbatches
+        assert self.num_micro >= 1
+        self.lr = lr
+        self.optimizer = optimizer
+        devs = devices if devices is not None else jax.devices()[:self.pp]
+        assert len(devs) == self.pp, "need one device per stage"
+        self.mesh = Mesh(np.asarray(devs), ("pp",))
+        self._step_fn = None
+        self._shapes = None
+        if sample_input is not None:
+            self._infer_shapes(sample_input)
+
+    # --------------------------------------------------------------- params
+    def state(self):
+        """Replicated param pytree: [(name, arrays-dict) per layer]; shared
+        layers appear once (by id) so tied weights stay tied."""
+        seen = {}
+        state, index = [], []
+        for layer in self.pl.run_funcs:
+            if id(layer) in seen:
+                index.append(seen[id(layer)])
+                continue
+            seen[id(layer)] = len(state)
+            index.append(len(state))
+            state.append(layer.raw_state()[0])
+        self._index = index
+        return state
+
+    def load_state(self, state):
+        seen = set()
+        for layer, idx in zip(self.pl.run_funcs, self._index):
+            if idx in seen:
+                continue
+            seen.add(idx)
+            named = dict(layer.named_parameters())
+            for name, arr in state[idx].items():
+                named[name].data = arr
+
+    # --------------------------------------------------------------- shapes
+    def _infer_shapes(self, sample_input):
+        """Trace per-stage boundary shapes abstractly (the reference
+        negotiates these at runtime via SendRecvMeta); jax.eval_shape costs
+        no compute."""
+        in_shape = tuple(np.asarray(
+            sample_input.shape if hasattr(sample_input, "shape")
+            else np.shape(sample_input)))
+        state = self.state()
+        shapes = [tuple(in_shape[1:])]
+        aval = jax.ShapeDtypeStruct(in_shape, jnp.float32)
+        for s in range(self.pp):
+            aval = jax.eval_shape(
+                lambda st, a, s=s: self._stage_apply(s, st, a), state, aval)
+            shapes.append(tuple(aval.shape[1:]))
+        self._shapes = shapes
+        # the carry must also hold the LAST stage's output (it is packed
+        # before the loss head unpacks it)
+        self._maxflat = max(int(np.prod(s)) for s in shapes)
+        return shapes
+
+    # ----------------------------------------------------------------- step
+    def _stage_apply(self, stage, state_list, arr):
+        lo, hi = self.pl._bounds[stage], self.pl._bounds[stage + 1]
+        for li in range(lo, hi):
+            layer = self.pl.run_funcs[li]
+            ff = self.pl._forward_funcs[li]
+            p = state_list[self._index[li]]
+            with layer.swap_state(p):
+                t = (layer(Tensor(arr)) if ff is None
+                     else ff(layer, Tensor(arr)))
+            arr = t.data if isinstance(t, Tensor) else t
+        return arr
+
+    def _local_step(self, state_list, x_all, labels, lr):
+        pp, num_micro = self.pp, self.num_micro
+        pp_idx = jax.lax.axis_index("pp")
+        B = x_all.shape[0]
+        assert B % num_micro == 0
+        mb = B // num_micro
+        maxflat = self._maxflat
+        lift = lambda v: (jax.lax.pcast(v, ("pp",), to="varying")
+                          if "pp" not in jax.typeof(v).vma else v)
+
+        def loss_fn(state_list):
+            # every pp-invariant operand consumed inside cond/switch
+            # branches is lifted HERE so AD's de-varying psum over 'pp'
+            # lands outside the predicated region (all ranks execute it)
+            st = jax.tree_util.tree_map(lift, state_list)
+            x_mb = lift(x_all.reshape(num_micro, mb, *x_all.shape[1:])
+                        .astype(jnp.float32))
+            lab_mb = lift(labels.reshape(num_micro, mb, *labels.shape[1:]))
+
+            def pack(a):
+                flat = a.reshape(mb, -1)
+                return jnp.pad(flat, ((0, 0), (0, maxflat - flat.shape[1])))
+
+            branches = []
+            for s in range(pp):
+                in_shape = self._shapes[s]
+
+                def br(st_, buf, s=s, in_shape=in_shape):
+                    a = buf[:, :int(np.prod(in_shape))].reshape(
+                        (mb,) + in_shape)
+                    out = self._stage_apply(s, st_, a)
+                    return pack(out)
+
+                branches.append(br)
+
+            def tick(carry, t):
+                state, loss_sum = carry
+                inp = pack(x_mb[jnp.clip(t, 0, num_micro - 1)])
+                state = jnp.where(pp_idx == 0, inp, state)
+                is_live = (t >= pp_idx) & (t - pp_idx < num_micro)
+                y = jax.lax.cond(
+                    is_live,
+                    lambda b: jax.lax.switch(
+                        pp_idx, [functools.partial(f, st) for f in branches],
+                        b),
+                    lambda b: b,
+                    state)
+                m = t - (pp - 1)
+                is_out = (pp_idx == pp - 1) & (m >= 0)
+                lab = lab_mb[jnp.clip(m, 0, num_micro - 1)]
+                out_shape = self._shapes[pp]
+
+                def live_loss(buf, ll):
+                    o = buf[:, :int(np.prod(out_shape))].reshape(
+                        (mb,) + out_shape)
+                    l = self.pl.loss_fn(Tensor(o), Tensor(ll))
+                    l = l.data if isinstance(l, Tensor) else l
+                    return lift(l.astype(jnp.float32))
+
+                l = jax.lax.cond(is_out, live_loss,
+                                 lambda buf, ll: lift(jnp.zeros(
+                                     (), jnp.float32)), y, lab)
+                loss_sum = loss_sum + l
+                state = jax.lax.ppermute(
+                    y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+                return (state, loss_sum), None
+
+            state0 = lift(jnp.zeros((mb, maxflat), jnp.float32))
+            zero = lift(jnp.zeros((), jnp.float32))
+            (state, loss_sum), _ = jax.lax.scan(
+                tick, (state0, zero), jnp.arange(num_micro + pp - 1))
+            # mean over microbatches; psum over pp (only last stage added)
+            return jax.lax.psum(loss_sum, "pp") / num_micro
+
+        loss, grads = jax.value_and_grad(loss_fn)(state_list)
+        # grads came out of loss_fn's lift-transpose already psum'd over pp
+        new_state = jax.tree_util.tree_map(
+            lambda p, g: (p - lr * g).astype(p.dtype), state_list, grads)
+        return new_state, loss
+
+    def build_step(self):
+        if self._step_fn is None:
+            mapped = jax.shard_map(
+                self._local_step, mesh=self.mesh,
+                in_specs=(P(), P(), P(), P()), out_specs=(P(), P()),
+                check_vma=True)
+            self._step_fn = jax.jit(mapped)
+        return self._step_fn
+
+    def train_batch(self, data, labels, state=None, lr=None):
+        """One pipeline-parallel SGD step; returns (new_state, loss).
+        Reference: PipelineParallel.train_batch (pipeline_parallel.py:153)."""
+        if self.pl.loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn to train")
+        data = jnp.asarray(data.data if isinstance(data, Tensor) else data)
+        labels = jnp.asarray(
+            labels.data if isinstance(labels, Tensor) else labels)
+        if self._shapes is None:
+            self._infer_shapes(data)
+        if state is None:
+            state = self.state()
+        fn = self.build_step()
+        lr = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
+        new_state, loss = fn(state, data, labels, lr)
+        return new_state, loss
