@@ -100,12 +100,15 @@ class HybridEngine:
         assert cfg.hidden % mp == 0 and cfg.ffn_hidden % mp == 0
         assert cfg.num_heads % mp == 0
         assert cfg.vocab_size % mp == 0
-        if sep > 1:
+        if sep > 1 and cfg.seq_parallel == "ulysses":
             assert (cfg.num_heads // mp) % sep == 0, \
-                "Ulysses needs local heads divisible by sep"
+                "Ulysses needs local heads divisible by sep " \
+                "(use seq_parallel='ring' to lift the head cap)"
         if pp > 1:
             assert self.ec.num_microbatches >= pp, \
                 "need microbatches >= pp for the pipeline"
+        assert cfg.seq_parallel in ("ulysses", "ring"), \
+            f"unknown seq_parallel {cfg.seq_parallel!r}"
         if ep > 1:
             assert cfg.moe_experts > 0, "ep>1 needs a MoE model"
         if cfg.moe_experts:
@@ -306,9 +309,13 @@ class HybridEngine:
         return (emb + pos).astype(self.cfg.jdtype())
 
     def _attention(self, q, k, v):
-        """Flash attention with Ulysses sequence parallelism.
+        """Flash attention with sequence parallelism (Ulysses or ring).
         q/k/v: [B, H_local, s_local, hd]."""
         sep = self.sep
+        if sep > 1 and self.cfg.seq_parallel == "ring":
+            from ..kernels.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, "sep", causal=True)
         if sep > 1:
             # all_to_all: gather sequence, scatter heads → [B, H/sep, S, hd]
             q, k, v = (jax.lax.all_to_all(t, "sep", split_axis=1,
@@ -467,15 +474,12 @@ class HybridEngine:
 
         # carry init must already have the vma the loop body produces
         # (scan requires fixed carry avals; pvary lifts the zeros)
-        carry_axes = tuple(sorted(set(jax.typeof(x).vma) | {"pp"}))
+        from ..core.vma import lifter
 
-        def lift(v):
-            """pcast v up to the carry's vma (cond branches must agree on
-            the varying-axis type; values like label-derived counts lack
-            pp/mp while stage outputs carry them)."""
-            missing = tuple(a for a in carry_axes
-                            if a not in jax.typeof(v).vma)
-            return jax.lax.pcast(v, missing, to="varying") if missing else v
+        carry_axes = tuple(sorted(set(jax.typeof(x).vma) | {"pp"}))
+        # cond branches must agree on the varying-axis type; values like
+        # label-derived counts lack pp/mp while stage outputs carry them
+        lift = lifter(*carry_axes)
 
         state0 = lift(jnp.zeros((mb,) + x.shape[1:], x.dtype))
         zero = lambda: lift(jnp.zeros((), jnp.float32))

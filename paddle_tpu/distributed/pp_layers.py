@@ -176,12 +176,24 @@ class PipelineEngine:
         self.num_micro = num_microbatches
         assert self.num_micro >= 1
         self.lr = lr
+        if optimizer != "sgd":
+            raise NotImplementedError(
+                f"PipelineEngine supports optimizer='sgd' only (got "
+                f"{optimizer!r}); for Adam-class training use HybridEngine")
         self.optimizer = optimizer
         devs = devices if devices is not None else jax.devices()[:self.pp]
         assert len(devs) == self.pp, "need one device per stage"
         self.mesh = Mesh(np.asarray(devs), ("pp",))
         self._step_fn = None
         self._shapes = None
+        self._in_shape = None
+        # layer-identity dedup index (shared layers appear once)
+        seen, self._index = {}, []
+        for layer in self.pl.run_funcs:
+            key = id(layer)
+            if key not in seen:
+                seen[key] = len(seen)
+            self._index.append(seen[key])
         if sample_input is not None:
             self._infer_shapes(sample_input)
 
@@ -189,16 +201,12 @@ class PipelineEngine:
     def state(self):
         """Replicated param pytree: [(name, arrays-dict) per layer]; shared
         layers appear once (by id) so tied weights stay tied."""
-        seen = {}
-        state, index = [], []
-        for layer in self.pl.run_funcs:
-            if id(layer) in seen:
-                index.append(seen[id(layer)])
+        state, seen = [], set()
+        for layer, idx in zip(self.pl.run_funcs, self._index):
+            if idx in seen:
                 continue
-            seen[id(layer)] = len(state)
-            index.append(len(state))
+            seen.add(idx)
             state.append(layer.raw_state()[0])
-        self._index = index
         return state
 
     def load_state(self, state):
@@ -227,6 +235,7 @@ class PipelineEngine:
                 lambda st, a, s=s: self._stage_apply(s, st, a), state, aval)
             shapes.append(tuple(aval.shape[1:]))
         self._shapes = shapes
+        self._in_shape = tuple(in_shape[1:])
         # the carry must also hold the LAST stage's output (it is packed
         # before the loss head unpacks it)
         self._maxflat = max(int(np.prod(s)) for s in shapes)
@@ -252,8 +261,9 @@ class PipelineEngine:
         assert B % num_micro == 0
         mb = B // num_micro
         maxflat = self._maxflat
-        lift = lambda v: (jax.lax.pcast(v, ("pp",), to="varying")
-                          if "pp" not in jax.typeof(v).vma else v)
+        from ..core.vma import lifter
+
+        lift = lifter("pp")
 
         def loss_fn(state_list):
             # every pp-invariant operand consumed inside cond/switch
@@ -342,7 +352,9 @@ class PipelineEngine:
         data = jnp.asarray(data.data if isinstance(data, Tensor) else data)
         labels = jnp.asarray(
             labels.data if isinstance(labels, Tensor) else labels)
-        if self._shapes is None:
+        if self._shapes is None or tuple(data.shape[1:]) != self._in_shape:
+            # re-derive boundary shapes for a new spatial layout; the jit
+            # retrace for the new input shape re-reads them
             self._infer_shapes(data)
         if state is None:
             state = self.state()

@@ -39,6 +39,11 @@ class GPTConfig:
     use_flash: bool = True
     remat: str = "dots"              # per-block checkpoint policy
     tie_embeddings: bool = True
+    # sequence parallelism flavor when the engine's sep axis > 1:
+    #   "ulysses" — all_to_all head-scatter (caps sep at local head count)
+    #   "ring"    — ring attention, KV blocks rotate on ICI (no head cap;
+    #               needs S/sep % 128 == 0 for the pallas tiles)
+    seq_parallel: str = "ulysses"
     # MoE (Mixtral-style): >0 replaces every block's dense FFN with a
     # moe_experts-expert MoE of the same per-expert hidden (ffn_hidden)
     moe_experts: int = 0
