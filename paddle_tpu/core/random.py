@@ -39,12 +39,21 @@ class _Stream:
 
 class _RandomState(threading.local):
     def __init__(self):
-        self.streams: dict[str, _Stream] = {"default": _Stream(np.random.randint(0, 2**31 - 1))}
+        # streams are created LAZILY: building a jax PRNG key initializes
+        # the jax backend, which must not happen at import time (the
+        # launcher imports this module before choosing a platform)
+        self.streams: dict[str, _Stream] = {}
         self.active = "default"
         self.override = None  # (base_key, counter) — jit-safe traced stream
 
 
 _state = _RandomState()
+
+
+def _stream(name: str) -> _Stream:
+    if name not in _state.streams:
+        _state.streams[name] = _Stream(np.random.randint(0, 2 ** 31 - 1))
+    return _state.streams[name]
 
 
 @contextlib.contextmanager
@@ -73,20 +82,20 @@ def split_key(stream: str | None = None):
         base, counter = _state.override
         _state.override[1] = counter + 1
         return jax.random.fold_in(base, counter)
-    name = stream or _state.active
-    if name not in _state.streams:
-        _state.streams[name] = _Stream(np.random.randint(0, 2**31 - 1))
-    s = _state.streams[name]
+    s = _stream(stream or _state.active)
     s.key, sub = jax.random.split(s.key)
     s.counter += 1
     return sub
 
 
 def current_key(stream: str = "default"):
-    return _state.streams[stream].key
+    if stream != "default" and stream not in _state.streams:
+        raise KeyError(f"rng stream {stream!r} not registered")
+    return _stream(stream).key
 
 
 def get_rng_state():
+    _stream("default")   # materialize so the snapshot is restorable
     return {name: (s.key, s.counter) for name, s in _state.streams.items()}
 
 

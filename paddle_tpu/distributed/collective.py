@@ -88,12 +88,68 @@ def _axis(group):
 # --------------------------------------------------------------- collectives
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _proc_mesh():
+    """1-D mesh with ONE device per process (the first), so a per-process
+    value contributes exactly once regardless of local device count."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    return Mesh(_np.array([per_proc[i] for i in sorted(per_proc)]), ("p",))
+
+
+@functools.lru_cache(maxsize=None)
+def _proc_reduce_fn(op):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+           ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+           ReduceOp.AVG: jnp.mean}[op]
+    # one cached jitted callable per op: repeated grad syncs reuse the
+    # compiled executable (per shape) instead of recompiling per call
+    return jax.jit(functools.partial(red, axis=0),
+                   out_shardings=NamedSharding(_proc_mesh(),
+                                               PartitionSpec()))
+
+
+def _cross_process_all_reduce(x, op=ReduceOp.SUM):
+    """Eager allreduce across *processes* (the launcher's one-process-per-
+    device model): build a global array from the per-process values, reduce
+    under jit with replicated output, read the local copy back.  This is
+    the TPU-native stand-in for the reference's eager ProcessGroup
+    allreduce (ProcessGroupNCCL.cc:317) — XLA runs the collective."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _proc_mesh()
+    stacked = NamedSharding(mesh, PartitionSpec("p"))
+    local = jnp.asarray(x)[None]
+    n = len(mesh.devices)
+    xg = jax.make_array_from_single_device_arrays(
+        (n,) + local.shape[1:], stacked,
+        [jax.device_put(local, _proc_mesh().devices.flat[
+            jax.process_index()])])
+    out = _proc_reduce_fn(op)(xg)
+    return jnp.asarray(out.addressable_data(0))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """c_allreduce_{sum,max,min,prod} analog; inside shard_map → lax.psum."""
+    """c_allreduce_{sum,max,min,prod} analog; inside shard_map → lax.psum;
+    eager with multiple processes → cross-process reduce via XLA."""
     axis = _axis(group)
     x = _unwrap(tensor)
     if axis is None:
-        out = x  # single participant
+        # concrete value + multiple processes = the launcher's eager DP
+        # path; a tracer here means we're inside jit with no group axis
+        if jax.process_count() > 1 and not isinstance(x, jax.core.Tracer):
+            out = _cross_process_all_reduce(x, op)
+        else:
+            out = x  # single participant
     elif op == ReduceOp.SUM:
         out = jax.lax.psum(x, axis)
     elif op == ReduceOp.MAX:

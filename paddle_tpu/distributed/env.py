@@ -5,6 +5,12 @@ TPU model: single-controller SPMD per host.  ``rank``/``world_size`` describe
 *processes* (hosts), as in jax.distributed; device-level parallelism lives in
 the mesh (topology.py).  Rendezvous: jax coordination service replaces the
 reference's TCPStore (distributed/store/tcp_store.cc).
+
+The launcher (`python -m paddle_tpu.distributed.launch`) writes the
+PADDLE_* env contract; ``init_parallel_env()`` consumes it and brings up
+the multi-process backend.  With ``PADDLE_DIST_BACKEND=gloo`` workers run
+on CPU devices with gloo collectives — the multi-process test fixture
+(the reference tests multi-node the same way: N local processes).
 """
 from __future__ import annotations
 
@@ -20,9 +26,10 @@ _initialized = [False]
 
 def init_parallel_env(coordinator_address=None, num_processes=None,
                       process_id=None):
-    """Initialize multi-host env.  Reads PADDLE_*/standard env when args are
-    absent; single-host (the common axon/test case) is a no-op that still
-    marks the env ready, mirroring init_parallel_env on one card."""
+    """Initialize the multi-process env from args or the launcher's
+    PADDLE_* contract; single-process (the common axon/test case) is a
+    no-op that still marks the env ready, mirroring init_parallel_env on
+    one card."""
     if _initialized[0]:
         return ParallelEnv()
     coord = coordinator_address or os.environ.get("PADDLE_MASTER") or \
@@ -31,6 +38,11 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
     pid = process_id if process_id is not None else \
         int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if coord and nproc > 1:
+        if os.environ.get("PADDLE_DIST_BACKEND") == "gloo":
+            # CPU multi-process fixture: the config knob is required — the
+            # axon TPU plugin ignores the JAX_PLATFORMS env var
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
     _initialized[0] = True
@@ -62,12 +74,27 @@ class ParallelEnv:
 
     @property
     def local_rank(self):
-        return get_rank()
+        """Rank within this node (launcher contract), NOT the global rank."""
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
 
     @property
     def device_id(self):
+        """The local device this process drives (one accelerator per
+        process under the launcher; id 0 under single-controller SPMD)."""
+        if "PADDLE_LOCAL_RANK" in os.environ and len(jax.local_devices()) > 1:
+            return self.local_rank % len(jax.local_devices())
         return 0
 
     @property
     def nranks(self):
         return get_world_size()
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = self.local_rank
+        return eps[r] if r < len(eps) else ""

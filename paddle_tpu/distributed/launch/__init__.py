@@ -1,0 +1,23 @@
+"""Process launcher — ``python -m paddle_tpu.distributed.launch``.
+
+Reference parity: python/paddle/distributed/launch/main.py:18 (``launch``)
++ launch/controllers/collective.py:32,89-91 (CollectiveController.build_pod
+env contract) + launch/job/container.py (per-rank ``workerlog.N`` files).
+
+TPU-native mapping: the reference forks one process per GPU and wires
+NCCL ids through a TCPStore; here each process is one jax *host* whose
+rendezvous is the jax coordination service (`jax.distributed.initialize`).
+On real multi-host TPU pods one process per host is the norm; for tests
+the same contract runs N CPU processes with gloo collectives.
+
+Env contract written per rank (reference names, collective.py:89-91):
+  PADDLE_TRAINER_ID        global rank
+  PADDLE_TRAINERS_NUM      world size
+  PADDLE_LOCAL_RANK        rank within this node
+  PADDLE_MASTER            coordinator host:port
+  PADDLE_TRAINER_ENDPOINTS comma list of worker endpoints
+  PADDLE_DIST_BACKEND      'tpu' (default) or 'gloo' (CPU testing)
+"""
+from .main import launch, main
+
+__all__ = ["launch", "main"]

@@ -1,0 +1,123 @@
+"""Launcher implementation (see package docstring for the env contract)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed training script, one process per "
+                    "host/worker (reference: paddle.distributed.launch)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes to fork on this node")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (default: local free port)")
+    p.add_argument("--log_dir", default="log",
+                   help="directory for per-rank workerlog.N files")
+    p.add_argument("--backend", default=None,
+                   choices=[None, "tpu", "gloo"],
+                   help="'gloo' runs workers on CPU devices (testing)")
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    """Fork nproc_per_node workers with the rank env contract, stream each
+    worker's output to ``<log_dir>/workerlog.<rank>``, watch them, and
+    propagate the first failure (terminating the rest) — the reference's
+    Controller.watch() policy (controllers/controller.py:67)."""
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    nproc = args.nproc_per_node
+    world = nproc * args.nnodes
+    if args.nnodes > 1 and not args.master:
+        raise SystemExit(
+            "--master host:port is required when nnodes > 1 (every node "
+            "must rendezvous at the same coordinator)")
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    endpoints = ",".join(
+        f"{master.split(':')[0]}:{_free_port()}" for _ in range(nproc))
+
+    procs, logs = [], []
+    for local_rank in range(nproc):
+        rank = args.node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+        })
+        if args.backend:
+            env["PADDLE_DIST_BACKEND"] = args.backend
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        logf = open(log_path, "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", args.training_script,
+             *args.training_script_args],
+            env=env, stdout=logf, stderr=subprocess.STDOUT))
+        logs.append(logf)
+
+    rc = 0
+    try:
+        while procs:
+            alive = []
+            for i, pr in enumerate(procs):
+                code = pr.poll()
+                if code is None:
+                    alive.append(pr)
+                elif code != 0:
+                    rc = code
+                    # one worker failed: take the pod down (reference
+                    # restart/exit policy, simplified to exit)
+                    for other in procs:
+                        if other.poll() is None:
+                            other.terminate()
+                    for other in procs:
+                        try:
+                            other.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            other.kill()
+                    procs = []
+                    break
+            else:
+                procs = alive
+                if procs:
+                    time.sleep(0.2)
+    except KeyboardInterrupt:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.send_signal(signal.SIGINT)
+        rc = 130
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

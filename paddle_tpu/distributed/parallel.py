@@ -31,8 +31,12 @@ class DataParallel(Layer):
         return self._layers(*args, **kwargs)
 
     def apply_collective_grads(self):
-        """Reducer analog: average grads across the dp group."""
-        n = self.group.nranks if self.group else 1
+        """Reducer analog: AVERAGE grads across the dp group (reference
+        DataParallel divides by nranks).  group=None = the world group:
+        under the launcher that is all processes."""
+        import jax
+
+        n = self.group.nranks if self.group else jax.process_count()
         for p in self._layers.parameters():
             if p.grad is not None and not p.stop_gradient:
                 all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)

@@ -363,7 +363,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
 
     def fit(b):
         b = min(b, S, 1024)
-        while S % b != 0:  # largest 128-multiple divisor of S under the cap
+        b -= b % 128       # align to the TPU tile (terminates the search)
+        while b > 128 and S % b:  # largest 128-multiple divisor under cap
             b -= 128
         return max(b, 128)
 

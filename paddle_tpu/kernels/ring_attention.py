@@ -156,9 +156,10 @@ def _pair_bwd(q, k, v, do, lse, delta, scale, causal, block_q, block_kv):
 def _fit_blocks(S, block_q, block_kv):
     def fit(b):
         b = min(b, S, 1024)
-        while S % b != 0:
+        b -= b % 128            # align to the TPU tile first
+        while b > 128 and S % b:
             b -= 128
-        return max(b, 128)
+        return max(b, 128)      # S % 128 == 0 guaranteed by the caller
 
     return fit(block_q), fit(block_kv)
 

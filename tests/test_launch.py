@@ -1,0 +1,123 @@
+"""Launcher + multi-process env tests (reference strategy: multi-node is
+simulated by multi-process on localhost — test_dist_base.py, SURVEY §4.3).
+
+These spawn REAL worker processes on CPU devices with gloo collectives, so
+the jax.distributed init path, the env contract, and the eager DP
+allreduce stop being dead code.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_OK = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+assert env.world_size == 2, env.world_size
+assert env.local_rank == int(os.environ["PADDLE_LOCAL_RANK"])
+assert env.rank == int(os.environ["PADDLE_TRAINER_ID"])
+assert len(env.trainer_endpoints) == 2
+
+import jax, jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+out = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                            in_specs=P("dp"), out_specs=P()))(
+    jnp.arange(4.0))
+np.testing.assert_allclose(np.asarray(out), [2.0, 4.0])
+print(f"WORKER_OK rank={{env.rank}} psum={{np.asarray(out).tolist()}}")
+"""
+
+WORKER_EAGER_DP = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+paddle.seed(7)   # same init on every rank
+model = nn.Linear(4, 2)
+dp = dist.DataParallel(model)
+rank = env.rank
+x = paddle.to_tensor(
+    np.full((2, 4), float(rank + 1), dtype=np.float32))
+loss = (dp(x) ** 2).mean()
+loss.backward()
+g_local = np.asarray(model.weight.grad.data).copy()
+dp.apply_collective_grads()
+g_sync = np.asarray(model.weight.grad.data)
+# synced grad must differ from the local one and equal the cross-rank mean
+assert not np.allclose(g_sync, g_local), "allreduce was a no-op"
+print(f"WORKER_DP rank={{rank}} glocal={{float(g_local.sum()):.6f}} "
+      f"gsum={{float(g_sync.sum()):.6f}}")
+"""
+
+WORKER_FAIL = """
+import os, sys, time
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+if rank == 1:
+    sys.exit(3)
+time.sleep(120)   # rank 0 hangs; the launcher must terminate it
+"""
+
+
+def _run_launch(tmp_path, worker_src, nproc=2, timeout=180):
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src.format(repo=REPO))
+    log_dir = tmp_path / "logs"
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(nproc), "--backend", "gloo",
+         "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    logs = {}
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs[f.name] = f.read_text()
+    return proc, logs
+
+
+class TestLauncher:
+    def test_two_process_collective(self, tmp_path):
+        proc, logs = _run_launch(tmp_path, WORKER_OK)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr, logs)
+        assert set(logs) == {"workerlog.0", "workerlog.1"}
+        for rank in (0, 1):
+            assert f"WORKER_OK rank={rank}" in logs[f"workerlog.{rank}"]
+            assert "psum=[2.0, 4.0]" in logs[f"workerlog.{rank}"]
+
+    def test_eager_data_parallel(self, tmp_path):
+        """VERDICT r2 #10: the eager DataParallel allreduce must really
+        synchronize grads across worker processes."""
+        proc, logs = _run_launch(tmp_path, WORKER_EAGER_DP)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr, logs)
+        locals_, sums = [], []
+        for rank in (0, 1):
+            line = [l for l in logs[f"workerlog.{rank}"].splitlines()
+                    if l.startswith("WORKER_DP")][0]
+            locals_.append(float(line.split("glocal=")[1].split()[0]))
+            sums.append(float(line.split("gsum=")[1]))
+        # both ranks hold the identical grad, and it is the MEAN of the
+        # two local grads (sum-without-divide would be 2x off)
+        assert abs(sums[0] - sums[1]) < 1e-6
+        expected = (locals_[0] + locals_[1]) / 2
+        assert abs(sums[0] - expected) < 1e-5, (sums, locals_)
+
+    def test_failure_propagates_and_terminates(self, tmp_path):
+        proc, logs = _run_launch(tmp_path, WORKER_FAIL, timeout=90)
+        assert proc.returncode == 3, (proc.returncode, proc.stdout)

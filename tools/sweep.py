@@ -69,13 +69,11 @@ if __name__ == "__main__":
 
     log(f"devices={jax.devices()}")
     configs = [
-        dict(batch=8, remat="dots", flash=True, async_steps=False),
-        dict(batch=8, remat="dots", flash=True, async_steps=True),
-        dict(batch=8, remat="nothing", flash=True, async_steps=True),
-        dict(batch=16, remat="dots", flash=True, async_steps=True),
-        dict(batch=16, remat="nothing", flash=True, async_steps=True),
-        dict(batch=32, remat="dots", flash=True, async_steps=True),
-        dict(batch=16, remat="dots", flash=False, async_steps=True),
+        dict(batch=32, remat="dots", flash=True, async_steps=True, accum=4),
+        dict(batch=16, remat="dots", flash=True, async_steps=True, accum=2),
+        dict(batch=12, remat="dots", flash=True, async_steps=True),
+        dict(batch=10, remat="dots", flash=True, async_steps=True),
+        dict(batch=8, remat="dots_no_batch", flash=True, async_steps=True),
     ]
     for c in configs:
         try:
