@@ -212,7 +212,7 @@ def bench_resnet(batch=32, steps=5):
             logits = model(paddle.Tensor(images))
             loss = paddle.nn.functional.cross_entropy(
                 logits, paddle.Tensor(labels))
-        return loss.value if hasattr(loss, "value") else loss
+        return loss.data if hasattr(loss, "data") else loss
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))
     t0 = time.perf_counter()

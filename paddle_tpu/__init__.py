@@ -54,6 +54,7 @@ from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
+from . import metric  # noqa: F401
 from . import vision  # noqa: F401
 from .framework_io import load, save  # noqa: F401
 
@@ -136,3 +137,5 @@ from .profiler.timer import Benchmark  # noqa: F401,E402
 
 # distributed is imported lazily (it builds meshes); expose the module path
 from . import distributed  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402

@@ -287,6 +287,116 @@ class HybridEngine:
         state = jax.jit(mapped)(params)
         return {"step": jnp.zeros((), jnp.int32), "slots": state}
 
+    # ------------------------------------------------ opt-state canonical
+    # The optimizer's [pp?, mp/ep?, zr, chunk] flat-chunk layout is
+    # topology-dependent; checkpoints store the TOPOLOGY-NEUTRAL form:
+    # m/v/master as param-shaped global arrays.  dist_saver/converter
+    # (auto_parallel/converter.py) solve the same problem by re-sharding
+    # host-side; here both directions are one shard_map program.
+
+    def opt_canonical(self):
+        """Returns a jitted (slots, params) → {'m','v','master'} trees of
+        param-shaped global arrays."""
+        from jax import shard_map
+
+        specs = self.param_specs()
+        zr = self.zr
+
+        def local(slots, params_local):
+            def un(slot_leaf, p_local, spec):
+                flat = slot_leaf[0, 0, 0]
+                if not (self._z3() and "sharding" in self._leaf_axes(spec)):
+                    # scatter-own-chunk + psum = the varying→invariant
+                    # all_gather (same idiom as the step's param rebuild)
+                    chunk = flat.shape[0]
+                    full = jnp.zeros((zr * chunk,), flat.dtype)
+                    idx = jax.lax.axis_index("sharding")
+                    full = jax.lax.dynamic_update_slice(
+                        full, flat, (idx * chunk,))
+                    flat = jax.lax.psum(full, "sharding")
+                n = int(np.prod(p_local.shape))
+                return flat[:n].reshape(p_local.shape)
+
+            is_slot = lambda x: isinstance(x, dict) and \
+                set(x) == {"m", "v", "master"}
+            out = {}
+            for name in ("m", "v", "master"):
+                out[name] = jax.tree_util.tree_map(
+                    lambda s, p, sp, name=name: un(s[name], p, sp),
+                    slots, params_local, specs, is_leaf=is_slot)
+            return out
+
+        out_specs = {k: specs for k in ("m", "v", "master")}
+        slots_specs = jax.tree_util.tree_map(
+            self._opt_leaf_spec, specs, is_leaf=lambda x: isinstance(x, P))
+        mapped = shard_map(local, mesh=self.mesh,
+                           in_specs=(slots_specs, specs),
+                           out_specs=out_specs, check_vma=True)
+        return jax.jit(mapped)
+
+    def opt_from_canonical(self):
+        """Inverse: param-shaped m/v/master → this engine's chunked slots
+        (the _init_opt layout on THIS mesh/zr/zero_stage)."""
+        from jax import shard_map
+
+        specs = self.param_specs()
+        zr = self.zr
+
+        def local(canon):
+            def chunk(val, spec):
+                n = int(np.prod(val.shape))
+                if self._z3() and "sharding" in self._leaf_axes(spec):
+                    return val.reshape(1, 1, 1, n).astype(jnp.float32)
+                c = -(-n // zr)
+                flat = jnp.pad(val.reshape(-1).astype(jnp.float32),
+                               (0, zr * c - n))
+                idx = jax.lax.axis_index("sharding")
+                mine = jax.lax.dynamic_slice_in_dim(
+                    flat.reshape(zr, c), idx, 1, axis=0)
+                return mine.reshape(1, 1, 1, c)
+
+            def build(m, v, master, spec):
+                return {"m": chunk(m, spec), "v": chunk(v, spec),
+                        "master": chunk(master, spec)}
+
+            return jax.tree_util.tree_map(
+                build, canon["m"], canon["v"], canon["master"], specs)
+
+        slots_specs = jax.tree_util.tree_map(
+            self._opt_leaf_spec, specs, is_leaf=lambda x: isinstance(x, P))
+        in_specs = {k: specs for k in ("m", "v", "master")}
+        mapped = shard_map(local, mesh=self.mesh, in_specs=(in_specs,),
+                           out_specs=slots_specs, check_vma=True)
+        return jax.jit(mapped)
+
+    def state_template(self):
+        """Shape/dtype/sharding templates for (params, canonical-opt)
+        WITHOUT allocating anything — the restore target for
+        checkpoint.load_engine_state on this topology."""
+        import types
+
+        from ..models.gpt import gpt_init
+
+        specs = self.param_specs()
+        shapes = jax.eval_shape(lambda k: gpt_init(self.cfg, k),
+                                jax.random.key(0))
+
+        def tmpl(sds, spec, dtype=None):
+            return types.SimpleNamespace(
+                shape=tuple(sds.shape), dtype=dtype or sds.dtype,
+                sharding=NamedSharding(self.mesh, spec))
+
+        params_t = jax.tree_util.tree_map(
+            tmpl, shapes, specs,
+            is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
+        canon_t = {
+            name: jax.tree_util.tree_map(
+                lambda s, sp: tmpl(s, sp, jnp.float32), shapes, specs,
+                is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
+            for name in ("m", "v", "master")
+        }
+        return params_t, canon_t
+
     # ------------------------------------------------------- forward pieces
     def _embed(self, params, tokens):
         """Vocab-parallel embedding + position embedding.

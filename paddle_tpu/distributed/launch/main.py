@@ -54,7 +54,10 @@ def launch(argv=None):
     master = args.master or f"127.0.0.1:{_free_port()}"
     os.makedirs(args.log_dir, exist_ok=True)
 
-    endpoints = ",".join(
+    # endpoint list is meaningful single-node only (this launcher cannot
+    # know other nodes' ports); multi-node rendezvous rides the jax
+    # coordinator, so the contract leaves PADDLE_TRAINER_ENDPOINTS empty
+    endpoints = "" if args.nnodes > 1 else ",".join(
         f"{master.split(':')[0]}:{_free_port()}" for _ in range(nproc))
 
     procs, logs = [], []

@@ -1,0 +1,306 @@
+"""hapi Model — the high-level fit/evaluate/predict loop.
+
+Reference parity: python/paddle/hapi/model.py:907 (``Model.fit``), :1557
+(``evaluate``), plus prepare/predict/save/load and train_batch/eval_batch.
+
+TPU-first: one jitted train step (pure function over the Layer's
+raw_state) instead of the reference's per-op dygraph loop — the Model owns
+the jit cache, the user keeps the familiar fit() surface.  Eager fallback
+runs when the loss needs python control flow.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x)
+
+
+class Model:
+    """High-level facade over a Layer (reference hapi.Model)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._jit_step = None
+        self._jit_eval = None
+        self._opt_state = None   # functional optimizer state (jit path)
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # ---------------------------------------------------------- jit pieces
+    def _build_jit_step(self):
+        if self._jit_step is not None:
+            return self._jit_step
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+
+        def pure_loss(params, buffers, x, y):
+            with net.swap_state(params, buffers):
+                out = net(Tensor(x))
+                loss = loss_fn(out, Tensor(y))
+                # capture buffer updates (BatchNorm running stats) BEFORE
+                # swap_state restores the originals on exit
+                new_buffers = {k: b.data for k, b in net.named_buffers()
+                               if b is not None}
+            out_arr = out.data if isinstance(out, Tensor) else out
+            l = loss.data if isinstance(loss, Tensor) else loss
+            return l, (out_arr, new_buffers)
+
+        grad_fn = jax.value_and_grad(pure_loss, has_aux=True)
+
+        def step(params, buffers, opt_state, x, y, lr):
+            (loss, (out, new_buffers)), grads = grad_fn(
+                params, buffers, x, y)
+            new_params, new_opt = opt.apply_gradients(
+                params, grads, opt_state, lr)
+            return new_params, new_opt, loss, out, new_buffers
+
+        self._jit_step = jax.jit(step)
+        return self._jit_step
+
+    # ------------------------------------------------- train / eval batch
+    def train_batch(self, inputs, labels):
+        """One optimization step; returns (loss, metric results)."""
+        x = _as_array(_to_list(inputs)[0])
+        y = _as_array(_to_list(labels)[0])
+        opt = self._optimizer
+        if hasattr(opt, "apply_gradients"):
+            params, buffers = self.network.raw_state()
+            if self._opt_state is None:
+                self._opt_state = opt.init_state(params)
+            step = self._build_jit_step()
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            new_params, self._opt_state, loss, out, new_buffers = step(
+                params, buffers, self._opt_state, x, y, lr)
+            named = dict(self.network.named_parameters())
+            for k, v in new_params.items():
+                named[k].data = v
+            named_b = {k: b for k, b in self.network.named_buffers()
+                       if b is not None}
+            for k, v in new_buffers.items():
+                named_b[k].data = v
+        else:
+            # eager fallback: the reference's dygraph train_batch
+            out_t = self.network(Tensor(x))
+            loss_t = self._loss(out_t, Tensor(y))
+            loss_t.backward()
+            opt.step()
+            opt.clear_grad()
+            loss = loss_t.data
+            out = out_t.data
+        results = self._update_metrics(out, y)
+        return float(loss), results
+
+    def eval_batch(self, inputs, labels):
+        x = _as_array(_to_list(inputs)[0])
+        y = _as_array(_to_list(labels)[0])
+        params, buffers = self.network.raw_state()
+
+        if self._jit_eval is None:
+            net, loss_fn = self.network, self._loss
+
+            def ev(params, buffers, x, y):
+                with net.swap_state(params, buffers):
+                    out = net(Tensor(x))
+                    loss = loss_fn(out, Tensor(y)) if loss_fn else None
+                out_arr = out.data if isinstance(out, Tensor) else out
+                l = (loss.data if isinstance(loss, Tensor) else
+                     jnp.zeros(())) if loss is not None else jnp.zeros(())
+                return l, out_arr
+
+            self._jit_eval = jax.jit(ev)
+        loss, out = self._jit_eval(params, buffers, x, y)
+        results = self._update_metrics(out, y)
+        return float(loss), results
+
+    def predict_batch(self, inputs):
+        x = _as_array(_to_list(inputs)[0])
+        params, buffers = self.network.raw_state()
+        with self.network.swap_state(params, buffers):
+            out = self.network(Tensor(x))
+        return np.asarray(out.data if isinstance(out, Tensor) else out)
+
+    def _update_metrics(self, out, y):
+        """Run each metric's compute→update and flatten list-named results
+        (Accuracy(topk=(1,5)) reports acc_top1/acc_top5 separately)."""
+        results = {}
+        for m in self._metrics:
+            res = m.compute(out, y)
+            val = m.update(*res) if isinstance(res, tuple) else m.update(res)
+            names = m.name()
+            if isinstance(names, list):
+                vals = val if isinstance(val, (list, tuple)) else [val]
+                results.update(dict(zip(names, vals)))
+            else:
+                results[names] = val
+        return results
+
+    # ------------------------------------------------------------- the fit
+    def _loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, shuffle=True, callbacks=None, **kw):
+        """Reference: hapi/model.py:907."""
+        train_loader = self._loader(train_data, batch_size, shuffle)
+        eval_loader = self._loader(eval_data, batch_size, False)
+        cbs = _to_list(callbacks)
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs = [ProgBarLogger(log_freq, verbose)] + cbs
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cblist = CallbackList(cbs, model=self,
+                              params={"epochs": epochs, "steps": steps,
+                                      "verbose": verbose,
+                                      "metrics": self._metric_names()})
+        self.stop_training = False
+        cblist.on_train_begin()
+        history = []
+        logs = {}
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cblist.on_train_batch_begin(step)
+                x, y = batch[0], batch[1]
+                loss, res = self.train_batch(x, y)
+                logs = {"loss": loss, **res}
+                cblist.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, callbacks=[],
+                                          verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cblist.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if self.stop_training:
+                break
+        cblist.on_train_end(logs)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 callbacks=None, **kw):
+        """Reference: hapi/model.py:1557."""
+        loader = self._loader(eval_data, batch_size, False)
+        cblist = CallbackList(_to_list(callbacks), model=self, params={})
+        for m in self._metrics:
+            m.reset()
+        cblist.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cblist.on_eval_batch_begin(step)
+            loss, res = self.eval_batch(batch[0], batch[1])
+            logs = {"loss": loss, **res}
+            cblist.on_eval_batch_end(step, logs)
+        cblist.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, **kw):
+        loader = self._loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return [np.concatenate(outs, axis=0)]
+
+    # ---------------------------------------------------------- save/load
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def save(self, path):
+        """Save params (+ optimizer state when prepared) —
+        reference: model.save(path) → path.pdparams / path.pdopt."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        params, buffers = self.network.raw_state()
+        blob = {"params": {k: np.asarray(v) for k, v in params.items()},
+                "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(blob, f, protocol=4)
+        if self._opt_state is not None:
+            blob_opt = jax.tree_util.tree_map(np.asarray, self._opt_state)
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(blob_opt, f, protocol=4)
+        elif self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(self._optimizer.state_dict(), f, protocol=4)
+
+    def load(self, path):
+        with open(path + ".pdparams", "rb") as f:
+            blob = pickle.load(f)
+        named = dict(self.network.named_parameters())
+        for k, v in blob["params"].items():
+            named[k].data = jnp.asarray(v)
+        named_b = {k: b for k, b in self.network.named_buffers()
+                   if b is not None}
+        for k, v in blob.get("buffers", {}).items():
+            if k in named_b:
+                named_b[k].data = jnp.asarray(v)
+        opt_path = path + ".pdopt"
+        if os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                blob_opt = pickle.load(f)
+            if isinstance(blob_opt, dict) and "slots" in blob_opt:
+                self._opt_state = jax.tree_util.tree_map(
+                    jnp.asarray, blob_opt)
+            elif self._optimizer is not None and \
+                    hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(blob_opt)
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None):
+        total = sum(int(np.prod(p.shape))
+                    for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: "
+                 f"{total:,} parameters"]
+        return "\n".join(lines)

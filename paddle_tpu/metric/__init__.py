@@ -1,0 +1,129 @@
+"""Metrics (parity: python/paddle/metric/metrics.py — Metric base,
+Accuracy, Precision, Recall, Auc).
+
+Pure-host accumulators over device results; compute() runs on device
+(jnp) and update() accumulates python floats, matching the reference's
+split between the compute op and the stateful accumulator.
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall"]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        x = x.data
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base accumulator (reference: metric/metrics.py ``Metric``)."""
+
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__.lower()
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label, *args):
+        """Optional device-side pre-processing before update()."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: metrics.py ``Accuracy``)."""
+
+    def __init__(self, topk=(1,), name="acc"):
+        super().__init__(name)
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_arr = pred.data if isinstance(pred, Tensor) else jnp.asarray(pred)
+        label_arr = label.data if isinstance(label, Tensor) else \
+            jnp.asarray(label)
+        k = max(self.topk)
+        top = jnp.argsort(pred_arr, axis=-1)[..., ::-1][..., :k]
+        if label_arr.ndim == pred_arr.ndim:      # one-hot / [N,1] label
+            label_arr = label_arr.squeeze(-1)
+        correct = (top == label_arr[..., None]).astype(jnp.float32)
+        return correct
+
+    def update(self, correct):
+        c = _np(correct)
+        n = c.shape[0]
+        for i, k in enumerate(self.topk):
+            self._correct[i] += float(c[..., :k].sum())
+        self._count += n
+        return self.accumulate()
+
+    def accumulate(self):
+        res = [c / self._count if self._count else 0.0
+               for c in self._correct]
+        return res[0] if len(res) == 1 else res
+
+    def reset(self):
+        self._correct = [0.0] * len(self.topk)
+        self._count = 0
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class _BinaryStat(Metric):
+    def __init__(self, name):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fp = 0.0
+        self.fn = 0.0
+
+    def update(self, pred, label):
+        p = (_np(pred).ravel() > 0.5).astype(np.float32)
+        l = _np(label).ravel().astype(np.float32)
+        self.tp += float(((p == 1) & (l == 1)).sum())
+        self.fp += float(((p == 1) & (l == 0)).sum())
+        self.fn += float(((p == 0) & (l == 1)).sum())
+        return self.accumulate()
+
+
+class Precision(_BinaryStat):
+    def __init__(self, name="precision"):
+        super().__init__(name)
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(_BinaryStat):
+    def __init__(self, name="recall"):
+        super().__init__(name)
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0

@@ -1,0 +1,172 @@
+"""hapi Model.fit tests (reference: hapi/model.py Model surface + the
+test_model.py MNIST-LeNet scenario, shrunk to CPU-test size)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import (EarlyStopping, LRScheduler, Model,
+                             ModelCheckpoint)
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class ToyDataset(Dataset):
+    """Linearly-separable 2-class blobs."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = rng.randint(0, 2, (n,)).astype(np.int64)
+        self.x = (rng.randn(n, 8) * 0.3 +
+                  self.y[:, None].astype(np.float32) * 2.0
+                  ).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _net(seed=3):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+def _model(seed=3, lr=0.1):
+    model = Model(_net(seed))
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+class TestFit:
+    def test_fit_learns(self):
+        model = _model()
+        hist = model.fit(ToyDataset(), batch_size=16, epochs=4, verbose=0)
+        assert len(hist) == 4
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        assert hist[-1]["acc"] > 0.9
+
+    def test_evaluate_and_predict(self):
+        model = _model()
+        model.fit(ToyDataset(), batch_size=16, epochs=3, verbose=0)
+        logs = model.evaluate(ToyDataset(n=32, seed=9), batch_size=16,
+                              verbose=0)
+        assert logs["acc"] > 0.9
+        preds = model.predict(ToyDataset(n=32, seed=9), batch_size=16)
+        assert preds[0].shape == (32, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _model()
+        model.fit(ToyDataset(), batch_size=16, epochs=2, verbose=0)
+        ref = model.evaluate(ToyDataset(n=32, seed=9), verbose=0)
+        model.save(str(tmp_path / "ck"))
+        assert os.path.exists(tmp_path / "ck.pdparams")
+
+        fresh = _model(seed=99)   # different init
+        fresh.load(str(tmp_path / "ck"))
+        got = fresh.evaluate(ToyDataset(n=32, seed=9), verbose=0)
+        np.testing.assert_allclose(got["loss"], ref["loss"], atol=1e-5)
+
+    def test_checkpoint_callback(self, tmp_path):
+        model = _model()
+        model.fit(ToyDataset(), batch_size=16, epochs=2, verbose=0,
+                  save_dir=str(tmp_path), save_freq=1)
+        assert os.path.exists(tmp_path / "0.pdparams")
+        assert os.path.exists(tmp_path / "final.pdparams")
+
+    def test_early_stopping(self):
+        model = _model(lr=0.0)   # loss cannot improve
+        es = EarlyStopping(monitor="loss", patience=1, mode="min")
+        hist = model.fit(ToyDataset(), batch_size=16, epochs=10, verbose=0,
+                         callbacks=[es])
+        assert len(hist) < 10
+        assert es.stopped_epoch >= 0
+
+    def test_lr_scheduler_callback(self):
+        paddle.seed(3)
+        net = _net()
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=2, gamma=0.5)
+        model = Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(ToyDataset(n=64), batch_size=16, epochs=1, verbose=0,
+                  callbacks=[LRScheduler(by_step=True)])
+        assert opt.get_lr() < 0.1   # 4 batches > step_size=2 -> decayed
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        from paddle_tpu.metric import Accuracy
+
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+        label = np.array([1, 2])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5       # second sample top1 wrong
+        assert top2 == 1.0       # both labels inside the top-2 sets
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_precision_recall(self):
+        from paddle_tpu.metric import Precision, Recall
+
+        p, r = Precision(), Recall()
+        pred = np.array([0.9, 0.8, 0.2, 0.6])
+        label = np.array([1, 0, 1, 1])
+        assert abs(p.update(pred, label) - 2 / 3) < 1e-6
+        assert abs(r.update(pred, label) - 2 / 3) < 1e-6
+
+
+class TestModelEdgeCases:
+    def test_fit_zero_epochs(self):
+        model = _model()
+        hist = model.fit(ToyDataset(), batch_size=16, epochs=0, verbose=0)
+        assert hist == []
+
+    def test_accuracy_topk_through_model(self):
+        paddle.seed(3)
+        model = Model(_net())
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy(topk=(1, 2)))
+        hist = model.fit(ToyDataset(), batch_size=16, epochs=1, verbose=0)
+        assert "acc_top1" in hist[0] and "acc_top2" in hist[0]
+        assert hist[0]["acc_top2"] == 1.0   # 2 classes: top2 is always hit
+
+    def test_precision_through_model_protocol(self):
+        """Base-class compute() returns (pred, label); Model must unpack."""
+        from paddle_tpu.metric import Precision
+
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 1), nn.Sigmoid(), nn.Flatten(0))
+        model = Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        model.prepare(opt, nn.MSELoss(), Precision())
+        ds = ToyDataset(n=32)
+        ds.y = ds.y.astype(np.float32)
+        hist = model.fit(ds, batch_size=16, epochs=1, verbose=0)
+        assert "precision" in hist[0]
+
+    def test_batchnorm_stats_update(self):
+        """Running statistics must survive the jitted step (they are
+        captured before swap_state restores the originals)."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.BatchNorm1D(8),
+                            nn.Linear(8, 2))
+        model = Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        bn = net[1]
+        before = np.asarray(bn._mean.data).copy()
+        model.fit(ToyDataset(), batch_size=16, epochs=1, verbose=0)
+        after = np.asarray(bn._mean.data)
+        assert not np.allclose(before, after), "BN stats never updated"
