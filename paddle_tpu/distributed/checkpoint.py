@@ -83,10 +83,17 @@ def save_sharded(path, tree, step=None, extra=None):
         os.makedirs(ldir, exist_ok=True)
         shards, seen = [], set()
         if hasattr(arr, "addressable_shards") and arr.addressable_shards:
+            # window → lowest owning process; only that process writes it,
+            # so replicated leaves cost one copy total, not one per host
+            owners = {}
+            for g in getattr(arr, "global_shards", arr.addressable_shards):
+                w = tuple(map(tuple, _index_to_json(g.index, arr.shape)))
+                pidx = g.device.process_index
+                owners[w] = min(owners.get(w, pidx), pidx)
             for shard in arr.addressable_shards:
                 win = tuple(map(tuple, _index_to_json(shard.index,
                                                       arr.shape)))
-                if win in seen:
+                if win in seen or owners.get(win, rank) != rank:
                     continue
                 seen.add(win)
                 fname = f"shard{tag}_{len(shards)}.npy"

@@ -448,14 +448,21 @@ class HybridEngine:
 
         return _naive_attention(q, k, v, causal=True, training=False)
 
-    def _block(self, bp, x):
+    def _block(self, bp, x, key=None):
         """One TP transformer block on local shards.
-        x: [B, s_local, D] (replicated over mp)."""
+        x: [B, s_local, D] (replicated over mp).  ``key`` must be
+        mp-INVARIANT (identical masks across a TP group — the reference's
+        RNGStatesTracker 'global_seed' discipline) and data-axis-varying
+        (distinct masks per data shard)."""
         cfg, mp = self.cfg, self.mp
         B, s_local, D = x.shape
         H_local = cfg.num_heads // mp
         hd = cfg.head_dim
-        from ..models.gpt import _layer_norm
+        from ..models.gpt import _dropout, _layer_norm
+
+        k_attn = k_ffn = None
+        if key is not None and cfg.dropout > 0.0:
+            k_attn, k_ffn = jax.random.split(key)
 
         h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
         qkv = jnp.einsum("bsd,de->bse", h, bp["qkv_w"]) + bp["qkv_b"]
@@ -470,7 +477,7 @@ class HybridEngine:
         proj = jnp.einsum("bse,ed->bsd", attn, bp["proj_w"])
         if mp > 1:
             proj = jax.lax.psum(proj, "mp")
-        x = x + proj + bp["proj_b"]
+        x = x + _dropout(proj + bp["proj_b"], cfg.dropout, k_attn)
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
         if cfg.moe_experts:
@@ -483,28 +490,39 @@ class HybridEngine:
                 h, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 ep_axis="ep" if self.ep > 1 else None)
-            return x + y, aux
+            return x + _dropout(y, cfg.dropout, k_ffn), aux
         h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
         h = jax.nn.gelu(h, approximate=True)
         down = jnp.einsum("bsf,fd->bsd", h, bp["down_w"])
         if mp > 1:
             down = jax.lax.psum(down, "mp")
-        return x + down + bp["down_b"], jnp.zeros((), jnp.float32)
+        return x + _dropout(down + bp["down_b"], cfg.dropout, k_ffn), \
+            jnp.zeros((), jnp.float32)
 
-    def _stage(self, blocks_local, x):
+    def _stage(self, blocks_local, x, key=None):
         """Scan this pipeline stage's blocks with per-block remat.
-        Returns (x, aux_sum) — the stage's summed MoE aux loss."""
+        Returns (x, aux_sum) — the stage's summed MoE aux loss.  ``key``
+        (optional) drives dropout; each block folds its GLOBAL layer index
+        so stages never share masks, and remat replays identical masks in
+        backward (explicit key = the reference's RNG-state preservation)."""
         from .recompute import checkpoint_policy
 
-        block_fn = lambda bp, x: self._block(self._z3_gather_block(bp), x)
+        block_fn = lambda bp, x, k: self._block(self._z3_gather_block(bp),
+                                                x, k)
         if self.cfg.remat != "nothing":
             block_fn = jax.checkpoint(
                 block_fn, policy=checkpoint_policy(self.cfg.remat),
                 prevent_cse=False)
 
-        def body(carry, bp):
+        n_local = self.cfg.num_layers // self.pp
+        layer0 = (jax.lax.axis_index("pp") * n_local) if self.pp > 1 else 0
+
+        def body(carry, xs):
             x, aux_sum = carry
-            x, aux = block_fn(bp, x)
+            bp, i = xs
+            k = (jax.random.fold_in(key, layer0 + i)
+                 if key is not None else None)
+            x, aux = block_fn(bp, x, k)
             return (x, aux_sum + aux), None
 
         # blocks are pp-varying, so each block application makes the carry
@@ -512,7 +530,8 @@ class HybridEngine:
         if "pp" not in jax.typeof(x).vma:
             x = jax.lax.pcast(x, ("pp",), to="varying")
         aux0 = jnp.zeros((), jnp.float32) + 0.0 * x.mean().astype(jnp.float32)
-        (out, aux_sum), _ = jax.lax.scan(body, (x, aux0), blocks_local)
+        (out, aux_sum), _ = jax.lax.scan(
+            body, (x, aux0), (blocks_local, jnp.arange(n_local)))
         return out, aux_sum
 
     def _head_params(self, params):
@@ -555,17 +574,23 @@ class HybridEngine:
         return total / denom
 
     # ---------------------------------------------------------- loss (SPMD)
-    def _local_loss(self, params, tokens, labels):
-        """Per-device loss: pipeline over pp, everything else TP/SP local."""
+    def _local_loss(self, params, tokens, labels, key=None):
+        """Per-device loss: pipeline over pp, everything else TP/SP local.
+        ``key``: dropout key, already folded with the data-axis coords
+        (mp-invariant, data-varying)."""
         cfg, pp = self.cfg, self.pp
         num_micro = self.ec.num_microbatches if pp > 1 else 1
         x = self._embed(params, tokens)          # [b, s_local, D]
+        if key is not None:
+            from ..models.gpt import _dropout
+
+            x = _dropout(x, cfg.dropout, jax.random.fold_in(key, 999983))
         b = x.shape[0]
         assert b % num_micro == 0, "local batch must divide microbatches"
         mb = b // num_micro
 
         if pp == 1:
-            out, aux = self._stage(params["blocks"], x)
+            out, aux = self._stage(params["blocks"], x, key)
             s, c = self._loss_head(self._head_params(params), out, labels)
             total = _psum_varying(jnp.stack([s, c]))
             loss = total[0] / jnp.maximum(total[1], 1.0)
@@ -614,7 +639,12 @@ class HybridEngine:
             is_live = (t >= pp_idx) & (t - pp_idx < num_micro)
 
             def live_stage(s):
-                ys, a = self._stage(params["blocks"], s)
+                # mask depends on (microbatch, global layer): fold the
+                # microbatch this stage holds at tick t
+                k = (jax.random.fold_in(key, jnp.clip(t - pp_idx, 0,
+                                                      num_micro - 1))
+                     if key is not None else None)
+                ys, a = self._stage(params["blocks"], s, k)
                 return lift(ys), lift(a)
 
             y, aux = jax.lax.cond(
@@ -648,10 +678,24 @@ class HybridEngine:
         return loss
 
     # ------------------------------------------------------------- the step
-    def _step_local(self, params, opt_state, tokens, labels, lr):
+    def _step_local(self, params, opt_state, tokens, labels, lr, seed):
         ec, zr = self.ec, self.zr
         accum = ec.accum_steps
         grad_fn = jax.value_and_grad(self._local_loss)
+        if self.cfg.dropout > 0.0:
+            # distinct masks per data shard (fold each data-axis coord),
+            # IDENTICAL masks across mp (never folded) — the reference's
+            # local_seed/global_seed split (parallel_layers/random.py:32).
+            # The optimizer step counter is folded in so a plain loop that
+            # never passes dropout_seed still gets fresh masks every step.
+            key = jax.random.fold_in(jax.random.key(seed),
+                                     opt_state["step"])
+            for ax, size in (("dp", self.dp), ("sharding", self.zr),
+                             ("ep", self.ep), ("sep", self.sep)):
+                if size > 1:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+        else:
+            key = None
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_slots = treedef.flatten_up_to(opt_state["slots"])
@@ -688,7 +732,7 @@ class HybridEngine:
             return chunks
 
         if accum == 1:
-            loss, grads = grad_fn(params, tokens, labels)
+            loss, grads = grad_fn(params, tokens, labels, key)
             g_chunks = to_chunks(grads)
         else:
             # gradient merge (reference: gradient_merge_optimizer): scan
@@ -702,7 +746,9 @@ class HybridEngine:
 
             def acc_body(carry, xs):
                 loss_sum, gsum = carry
-                l, g = grad_fn(params, xs[0], xs[1])
+                k = (jax.random.fold_in(key, xs[2])
+                     if key is not None else None)
+                l, g = grad_fn(params, xs[0], xs[1], k)
                 gc = to_chunks(g)
                 return (loss_sum + l,
                         tuple(a + c for a, c in zip(gsum, gc))), None
@@ -717,7 +763,8 @@ class HybridEngine:
             g0 = tuple(chunk_zero(p, z3)
                        for p, z3 in zip(flat_p, z3_leaf))
             (loss_sum, g_chunks), _ = jax.lax.scan(
-                acc_body, (jnp.zeros((), jnp.float32), g0), (tok, lab))
+                acc_body, (jnp.zeros((), jnp.float32), g0),
+                (tok, lab, jnp.arange(accum)))
             loss = loss_sum / accum
             g_chunks = [g / accum for g in g_chunks]
 
@@ -793,17 +840,21 @@ class HybridEngine:
         mapped = shard_map(
             self._step_local, mesh=self.mesh,
             in_specs=(specs, opt_specs, self.batch_spec(), self.batch_spec(),
-                      P()),
+                      P(), P()),
             out_specs=(specs, opt_specs, P()),
             check_vma=True,
         )
         self._step_fn = jax.jit(mapped, donate_argnums=(0, 1))
         return self._step_fn
 
-    def step(self, params, opt_state, tokens, labels, lr=None):
+    def step(self, params, opt_state, tokens, labels, lr=None,
+             dropout_seed=0):
+        """One hybrid-parallel train step.  ``dropout_seed`` varies the
+        dropout masks per step (ignored when cfg.dropout == 0)."""
         fn = self.build_step()
         lr = jnp.asarray(lr if lr is not None else self.ec.lr, jnp.float32)
-        return fn(params, opt_state, tokens, labels, lr)
+        seed = jnp.asarray(dropout_seed, jnp.uint32)
+        return fn(params, opt_state, tokens, labels, lr, seed)
 
     # ----------------------------------------------------------- eval/debug
     def loss_fn_reference(self, params_host, tokens, labels):

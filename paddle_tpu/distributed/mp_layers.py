@@ -79,9 +79,19 @@ class ColumnParallelLinear(Layer):
         return specs
 
     def forward(self, x):
+        """GSPMD mode: plain matmul on the (sharded-by-spec) full weight.
+        Explicit mode (inside shard_map mapping 'mp', weights pre-split):
+        local matmul, then all_gather of the output columns when
+        gather_output — the reference's c_concat (mp_layers.py:97)."""
+        size, _ = _mp_info(self.mp_axis)
         out = ops.matmul(x, self.weight)
         if self.bias is not None:
             out = ops.add(out, self.bias)
+        if size > 1 and self.gather_output:
+            arr = out.data if isinstance(out, Tensor) else out
+            arr = jax.lax.all_gather(arr, self.mp_axis, axis=arr.ndim - 1,
+                                     tiled=True)
+            out = Tensor(arr) if isinstance(out, Tensor) else arr
         return out
 
 
@@ -116,7 +126,22 @@ class RowParallelLinear(Layer):
         return specs
 
     def forward(self, x):
+        """GSPMD mode: plain matmul (psum appears from the contraction over
+        the sharded dim).  Explicit mode: c_split the input unless it is
+        already parallel, local matmul, allreduce, THEN bias (adding it
+        pre-psum would count it mp times) — mp_layers.py:170 semantics."""
+        size, idx = _mp_info(self.mp_axis)
+        if size > 1 and not self.input_is_parallel:
+            arr = x.data if isinstance(x, Tensor) else x
+            in_local = self.weight.shape[0]
+            arr = jax.lax.dynamic_slice_in_dim(
+                arr, idx * in_local, in_local, axis=arr.ndim - 1)
+            x = Tensor(arr) if isinstance(x, Tensor) else arr
         out = ops.matmul(x, self.weight)
+        if size > 1:
+            arr = out.data if isinstance(out, Tensor) else out
+            arr = jax.lax.psum(arr, self.mp_axis)
+            out = Tensor(arr) if isinstance(out, Tensor) else arr
         if self.bias is not None:
             out = ops.add(out, self.bias)
         return out

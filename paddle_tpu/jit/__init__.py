@@ -110,34 +110,105 @@ def not_to_static(fn):
 
 
 def save(layer, path, input_spec=None, example_inputs=None):
-    """paddle.jit.save parity: persist params + serialized StableHLO program.
+    """paddle.jit.save parity: persist params + an EXECUTABLE program.
 
-    Artifact layout: ``{path}.pdiparams.npz`` (weights) + ``{path}.stablehlo``
-    (program text, requires example_inputs) + ``{path}.pdmodel.json`` (meta).
+    Artifact layout:
+      ``{path}.pdiparams.npz``   parameter arrays (raw_state names)
+      ``{path}.pdibuffers.npz``  buffer arrays
+      ``{path}.pdmodel``         jax.export serialized program (versioned
+                                 StableHLO + calling convention) — the
+                                 AnalysisPredictor-loadable artifact;
+                                 written when example_inputs are given
+      ``{path}.stablehlo``       human-readable program text
+      ``{path}.pdmodel.json``    metadata
     """
     import json
 
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    state = layer.state_dict() if isinstance(layer, Layer) else {}
-    arrays = {k: np.asarray(v.data) for k, v in state.items()}
-    np.savez(path + ".pdiparams.npz", **arrays)
-    meta = {"class": type(layer).__name__, "keys": list(arrays)}
+    meta = {"class": type(layer).__name__}
+    if isinstance(layer, TracedLayer):
+        traced, target = layer, layer.target
+    else:
+        traced, target = TracedLayer(layer), layer
+    if isinstance(target, Layer):
+        params, buffers = target.raw_state()
+    else:
+        params, buffers = {}, {}
+    np.savez(path + ".pdiparams.npz",
+             **{k: np.asarray(v) for k, v in params.items()})
+    np.savez(path + ".pdibuffers.npz",
+             **{k: np.asarray(v) for k, v in buffers.items()})
+    meta["keys"] = list(params)
     if example_inputs is not None:
-        traced = layer if isinstance(layer, TracedLayer) else TracedLayer(layer)
-        hlo = traced.stablehlo(*example_inputs)
+        arr_args = traced._unwrap(tuple(example_inputs))
+        if traced.is_layer:
+            exported = jax.export.export(traced._compiled)(
+                params, buffers, *arr_args)
+        else:
+            exported = jax.export.export(traced._compiled)(*arr_args)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(bytes(exported.serialize()))
         with open(path + ".stablehlo", "w") as f:
-            f.write(hlo)
+            f.write(traced.stablehlo(*example_inputs))
         meta["has_program"] = True
+        meta["program_takes_state"] = traced.is_layer
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(meta, f)
 
 
+class Predictor:
+    """Executes a ``jit.save`` artifact WITHOUT the original Python class —
+    the serving-side predictor (reference role:
+    inference/api/analysis_predictor.cc).  The program is the serialized
+    jax.export artifact; weights load from the .npz files."""
+
+    def __init__(self, path):
+        import json
+
+        with open(path + ".pdmodel.json") as f:
+            self.meta = json.load(f)
+        if not self.meta.get("has_program"):
+            raise ValueError(
+                f"{path} was saved without example_inputs — no executable "
+                "program; re-save with example_inputs or pass layer= to "
+                "jit.load")
+        with open(path + ".pdmodel", "rb") as f:
+            self._exported = jax.export.deserialize(bytearray(f.read()))
+        self._takes_state = self.meta.get("program_takes_state", False)
+        p = np.load(path + ".pdiparams.npz")
+        self._params = {k: jax.numpy.asarray(p[k]) for k in p.files}
+        b = np.load(path + ".pdibuffers.npz")
+        self._buffers = {k: jax.numpy.asarray(b[k]) for k in b.files}
+
+    def __call__(self, *inputs):
+        arrs = tuple(a.data if isinstance(a, Tensor) else jax.numpy.asarray(a)
+                     for a in inputs)
+        if self._takes_state:
+            out = self._exported.call(self._params, self._buffers, *arrs)
+        else:
+            out = self._exported.call(*arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    run = __call__
+
+
 def load(path, layer=None):
-    """paddle.jit.load parity: restore weights into ``layer`` (and return a
-    TracedLayer over it)."""
-    data = np.load(path + ".pdiparams.npz")
-    state = {k: Tensor(np.asarray(data[k])) for k in data.files}
+    """paddle.jit.load parity.
+
+    With ``layer``: restore weights into it and return a TracedLayer.
+    Without: return a ``Predictor`` that EXECUTES the saved program with
+    no Python model class in sight."""
     if layer is not None:
+        data = np.load(path + ".pdiparams.npz")
+        state = {k: Tensor(np.asarray(data[k])) for k in data.files}
         layer.set_state_dict(state)
+        bpath = path + ".pdibuffers.npz"
+        if os.path.exists(bpath):
+            bdata = np.load(bpath)
+            named_b = {k: b for k, b in layer.named_buffers()
+                       if b is not None}
+            for k in bdata.files:
+                if k in named_b:
+                    named_b[k].data = jax.numpy.asarray(bdata[k])
         return TracedLayer(layer)
-    return state
+    return Predictor(path)

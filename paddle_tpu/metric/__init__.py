@@ -64,8 +64,11 @@ class Accuracy(Metric):
             jnp.asarray(label)
         k = max(self.topk)
         top = jnp.argsort(pred_arr, axis=-1)[..., ::-1][..., :k]
-        if label_arr.ndim == pred_arr.ndim:      # one-hot / [N,1] label
-            label_arr = label_arr.squeeze(-1)
+        if label_arr.ndim == pred_arr.ndim:
+            if label_arr.shape[-1] == pred_arr.shape[-1]:
+                label_arr = jnp.argmax(label_arr, axis=-1)  # one-hot
+            else:
+                label_arr = label_arr.squeeze(-1)           # [N, 1]
         correct = (top == label_arr[..., None]).astype(jnp.float32)
         return correct
 

