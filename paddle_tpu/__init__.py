@@ -55,6 +55,8 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
+from . import fft  # noqa: F401
+from . import distribution  # noqa: F401
 from . import vision  # noqa: F401
 from .framework_io import load, save  # noqa: F401
 

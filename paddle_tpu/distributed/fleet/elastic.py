@@ -1,0 +1,112 @@
+"""Elastic training manager (reference parity:
+python/paddle/distributed/fleet/elastic/manager.py — ElasticManager
+registers nodes in etcd, watches membership, and triggers relaunch; the
+trainer requests relaunch by exiting with ELASTIC_EXIT_CODE=101,
+manager.py:37).
+
+TPU-native: membership rides the framework's own native TCPStore
+(distributed/store.py) instead of etcd — same watch/heartbeat contract,
+no external service.  The launcher's --max_restarts implements the
+relaunch policy (reference: launch/controllers/controller.py watch loop).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from ..store import TCPStore
+
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE", "enable_elastic"]
+
+ELASTIC_EXIT_CODE = 101
+
+
+def enable_elastic():
+    """Reference: fleet/elastic/__init__.py:28 — elastic is on when the
+    PADDLE_ELASTIC_* env contract is present."""
+    return bool(os.environ.get("PADDLE_ELASTIC_NP"))
+
+
+class ElasticManager:
+    """Node membership with heartbeats over a shared KV store.
+
+    * register() announces this node and starts a heartbeat thread
+    * alive_nodes() lists nodes with fresh heartbeats
+    * match() — membership equals the expected np
+    * watch(timeout) — blocks until membership changes from matching to
+      broken (node lost / joined), returns the event
+    """
+
+    def __init__(self, store: TCPStore = None, job_id="default", np=1,
+                 host=None, heartbeat_interval=0.5, node_timeout=2.0):
+        if store is None:
+            endpoint = os.environ.get("PADDLE_ELASTIC_SERVER",
+                                      "127.0.0.1:0")
+            h, p = endpoint.rsplit(":", 1)
+            store = TCPStore(host=h, port=int(p), is_master=(int(p) == 0),
+                             world_size=np)
+        self.store = store
+        self.job = job_id
+        self.np = int(os.environ.get("PADDLE_ELASTIC_NP", np))
+        self.host = host or f"{socket.gethostname()}-{os.getpid()}"
+        self.heartbeat_interval = heartbeat_interval
+        self.node_timeout = node_timeout
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---------------------------------------------------------- membership
+    def _key(self):
+        return f"elastic/{self.job}/{self.host}"
+
+    def register(self):
+        self.store.set(self._key(), str(time.time()))
+
+        def beat():
+            while not self._stop.wait(self.heartbeat_interval):
+                self.store.set(self._key(), str(time.time()))
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def deregister(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self.store.delete_key(self._key())
+
+    # host lists are explicit (PADDLE_TRAINERS in the reference); the KV
+    # store is scanless by design, so peers are probed by name
+    def probe(self, host):
+        try:
+            raw = self.store.get(f"elastic/{self.job}/{host}",
+                                 blocking=False)
+        except KeyError:
+            return False
+        return (time.time() - float(raw.decode())) < self.node_timeout
+
+    def match(self, hosts):
+        """True when every expected host is alive and none extra expected."""
+        alive = [h for h in hosts if self.probe(h)]
+        return len(alive) == self.np
+
+    def wait_for_np(self, hosts, timeout=30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.match(hosts):
+                return True
+            time.sleep(self.heartbeat_interval)
+        return False
+
+    def watch(self, hosts, timeout=60.0):
+        """Block until membership breaks (a host dies) or timeout.
+        Returns ('lost', [hosts]) / ('ok', []) on timeout."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            dead = [h for h in hosts if not self.probe(h)]
+            if dead:
+                return ("lost", dead)
+            time.sleep(self.heartbeat_interval)
+        return ("ok", [])

@@ -34,6 +34,11 @@ def _parse(argv):
     p.add_argument("--backend", default=None,
                    choices=[None, "tpu", "gloo"],
                    help="'gloo' runs workers on CPU devices (testing)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic relaunch budget: restart the pod when a "
+                        "worker exits with ELASTIC_EXIT_CODE (101) or "
+                        "crashes, up to this many times (reference: "
+                        "fleet/elastic relaunch policy)")
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -60,53 +65,74 @@ def launch(argv=None):
     endpoints = "" if args.nnodes > 1 else ",".join(
         f"{master.split(':')[0]}:{_free_port()}" for _ in range(nproc))
 
-    procs, logs = [], []
-    for local_rank in range(nproc):
-        rank = args.node_rank * nproc + local_rank
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_LOCAL_RANK": str(local_rank),
-            "PADDLE_MASTER": master,
-            "PADDLE_TRAINER_ENDPOINTS": endpoints,
-        })
-        if args.backend:
-            env["PADDLE_DIST_BACKEND"] = args.backend
-        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
-        logf = open(log_path, "w")
-        procs.append(subprocess.Popen(
-            [sys.executable, "-u", args.training_script,
-             *args.training_script_args],
-            env=env, stdout=logf, stderr=subprocess.STDOUT))
-        logs.append(logf)
+    def spawn_pod(attempt):
+        procs, logs = [], []
+        for local_rank in range(nproc):
+            rank = args.node_rank * nproc + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_MASTER": master,
+                "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                "PADDLE_RESTART_ATTEMPT": str(attempt),
+            })
+            if args.backend:
+                env["PADDLE_DIST_BACKEND"] = args.backend
+            log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+            mode = "a" if attempt else "w"
+            logf = open(log_path, mode)
+            if attempt:
+                logf.write(f"\n----- restart attempt {attempt} -----\n")
+                logf.flush()
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", args.training_script,
+                 *args.training_script_args],
+                env=env, stdout=logf, stderr=subprocess.STDOUT))
+            logs.append(logf)
+        return procs, logs
 
+    def teardown(procs):
+        for other in procs:
+            if other.poll() is None:
+                other.terminate()
+        for other in procs:
+            try:
+                other.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                other.kill()
+
+    attempt = 0
+    procs, logs = spawn_pod(attempt)
     rc = 0
     try:
         while procs:
             alive = []
-            for i, pr in enumerate(procs):
+            failed = None
+            for pr in procs:
                 code = pr.poll()
                 if code is None:
                     alive.append(pr)
                 elif code != 0:
-                    rc = code
-                    # one worker failed: take the pod down (reference
-                    # restart/exit policy, simplified to exit)
-                    for other in procs:
-                        if other.poll() is None:
-                            other.terminate()
-                    for other in procs:
-                        try:
-                            other.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            other.kill()
-                    procs = []
+                    failed = code
                     break
-            else:
-                procs = alive
-                if procs:
-                    time.sleep(0.2)
+            if failed is not None:
+                teardown(procs)
+                for f in logs:
+                    f.close()
+                if attempt < args.max_restarts:
+                    # elastic relaunch: a worker asked for restart (101)
+                    # or crashed — restart the whole pod
+                    attempt += 1
+                    procs, logs = spawn_pod(attempt)
+                    continue
+                rc = failed
+                procs = []
+                break
+            procs = alive
+            if procs:
+                time.sleep(0.2)
     except KeyboardInterrupt:
         for pr in procs:
             if pr.poll() is None:

@@ -1,0 +1,111 @@
+"""TCPStore — the rendezvous KV store (reference parity:
+paddle/fluid/distributed/store/tcp_store.cc + core.TCPStore used by
+parallel.py:237).
+
+The wire server/client are NATIVE C++ (native/tcp_store.cpp, built on
+first use); this module is the thin Python facade matching the reference
+API: set/get/add/wait + a counter-based barrier.  jax's own rendezvous is
+the coordination service — TCPStore exists for user-level coordination
+(the reference exposes it publicly) and for the elastic manager.
+"""
+from __future__ import annotations
+
+import time
+
+from ..native import load_tcp_store_lib
+
+__all__ = ["TCPStore"]
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30.0):
+        self._lib = load_tcp_store_lib()
+        self._server = None
+        self.world_size = world_size
+        self.timeout = timeout
+        if is_master:
+            self._server = self._lib.ts_server_start(int(port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore master failed to bind :{port}")
+            port = self._lib.ts_server_port(self._server)
+        self.host, self.port = host, int(port)
+        self._client = self._lib.ts_client_connect(
+            host.encode(), self.port, float(timeout))
+        if not self._client:
+            self._close_server()
+            raise TimeoutError(
+                f"TCPStore could not reach {host}:{self.port} "
+                f"within {timeout}s")
+
+    # ------------------------------------------------------------------ kv
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.ts_set(self._client, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed rc={rc}")
+
+    def get(self, key: str, blocking=True) -> bytes:
+        import ctypes
+
+        buf = ctypes.create_string_buffer(1 << 20)
+        if blocking:
+            n = self._lib.ts_wait(self._client, key.encode(), buf, len(buf))
+        else:
+            n = self._lib.ts_get(self._client, key.encode(), buf, len(buf))
+            if n == -1:
+                raise KeyError(key)
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed rc={n}")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = self._lib.ts_add(self._client, key.encode(), int(delta))
+        if v == -1:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, keys, timeout=None):
+        for k in (keys if isinstance(keys, (list, tuple)) else [keys]):
+            self.get(k, blocking=True)
+
+    def delete_key(self, key: str):
+        self._lib.ts_delete(self._client, key.encode())
+
+    # -------------------------------------------------------------- barrier
+    def barrier(self, name="_barrier", timeout=None):
+        """Counter barrier over ``world_size`` participants."""
+        timeout = timeout or self.timeout
+        n = self.add(f"{name}/count", 1)
+        gen = (n - 1) // self.world_size   # re-usable barrier generations
+        target = (gen + 1) * self.world_size
+        deadline = time.time() + timeout
+        while True:
+            import ctypes
+
+            buf = ctypes.create_string_buffer(8)
+            got = self._lib.ts_get(self._client,
+                                   f"{name}/count".encode(), buf, 8)
+            if got >= 0:
+                cur = int.from_bytes(buf.raw[:8], "little", signed=True)
+                if cur >= target:
+                    return
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name!r} timed out "
+                                   f"({cur}/{target})")
+            time.sleep(0.01)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.ts_client_close(self._client)
+                self._client = None
+            self._close_server()
+        except Exception:
+            pass
+
+    def _close_server(self):
+        if getattr(self, "_server", None):
+            self._lib.ts_server_stop(self._server)
+            self._server = None

@@ -1,0 +1,224 @@
+"""Probability distributions (parity: python/paddle/distribution/ —
+Distribution base, Normal, Uniform, Categorical, Bernoulli, Beta,
+Dirichlet, kl_divergence).
+
+TPU-native: sampling draws explicit jax PRNG keys from the framework's
+stateful stream (core.random.split_key), so the same code works eagerly
+and under jit (where key_stream installs a traced key).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.random import split_key
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "kl_divergence"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        out = self.log_prob(value)
+        return Tensor(jnp.exp(out.data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        z = jax.random.normal(
+            split_key(), shape + jnp.broadcast_shapes(self.loc.shape,
+                                                      self.scale.shape))
+        return Tensor(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + jnp.zeros_like(self.scale))
+
+    @property
+    def variance(self):
+        return Tensor(self.scale ** 2 + jnp.zeros_like(self.loc))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        u = jax.random.uniform(
+            split_key(), shape + jnp.broadcast_shapes(self.low.shape,
+                                                      self.high.shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is None:
+            logits = jnp.log(jnp.clip(_arr(probs), 1e-38))
+        self.logits = _arr(logits)
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=(), seed=0):
+        return Tensor(jax.random.categorical(split_key(), self.logits,
+                                             shape=tuple(shape)
+                                             + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = jnp.asarray(_arr(value), jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-(p * logp).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _arr(probs)
+        else:
+            self.probs_ = jax.nn.sigmoid(_arr(logits))
+
+    def sample(self, shape=(), seed=0):
+        u = jax.random.uniform(split_key(), tuple(shape) + self.probs_.shape)
+        return Tensor((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs_)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+
+    def sample(self, shape=(), seed=0):
+        return Tensor(jax.random.beta(split_key(), self.alpha, self.beta,
+                                      tuple(shape)
+                                      + jnp.broadcast_shapes(
+                                          self.alpha.shape,
+                                          self.beta.shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+
+        v = _arr(value)
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+
+    def sample(self, shape=(), seed=0):
+        return Tensor(jax.random.dirichlet(split_key(), self.concentration,
+                                           tuple(shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _arr(value)
+        a = self.concentration
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1)
+                      + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+
+
+# ----------------------------------------------------------------- KL table
+
+
+def kl_divergence(p, q):
+    """Parity: paddle.distribution.kl_divergence (registered pairs)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_p, var_q = p.scale ** 2, q.scale ** 2
+        out = (jnp.log(q.scale / p.scale)
+               + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+        return Tensor(out)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, -1)
+        logq = jax.nn.log_softmax(q.logits, -1)
+        return Tensor((jnp.exp(logp) * (logp - logq)).sum(-1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                      + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        inside = (q.low <= p.low) & (p.high <= q.high)
+        kl = jnp.log((q.high - q.low) / (p.high - p.low))
+        return Tensor(jnp.where(inside, kl, jnp.inf))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__}) "
+        "not registered")

@@ -1,0 +1,93 @@
+"""FFT API (parity: python/paddle/fft.py — fft/ifft/rfft/irfft families,
+fftn variants, fftshift helpers, fftfreq).
+
+Thin over jnp.fft: XLA owns the FFT kernels on TPU, so unlike most of the
+reference's operator corpus there is nothing to re-implement — only the
+norm/axis argument surface to match.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _unwrap(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(x, out):
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def _norm(norm):
+    # paddle uses 'backward'|'ortho'|'forward' like numpy>=1.20
+    return norm or "backward"
+
+
+def _make1(name):
+    fn = getattr(jnp.fft, name)
+
+    def op(x, n=None, axis=-1, norm=None, name_=None):
+        return _wrap(x, fn(_unwrap(x), n=n, axis=axis, norm=_norm(norm)))
+
+    op.__name__ = name
+    return op
+
+
+def _make2(name):
+    fn = getattr(jnp.fft, name)
+
+    def op(x, s=None, axes=(-2, -1), norm=None, name_=None):
+        return _wrap(x, fn(_unwrap(x), s=s, axes=axes, norm=_norm(norm)))
+
+    op.__name__ = name
+    return op
+
+
+def _maken(name):
+    fn = getattr(jnp.fft, name)
+
+    def op(x, s=None, axes=None, norm=None, name_=None):
+        return _wrap(x, fn(_unwrap(x), s=s, axes=axes, norm=_norm(norm)))
+
+    op.__name__ = name
+    return op
+
+
+fft = _make1("fft")
+ifft = _make1("ifft")
+rfft = _make1("rfft")
+irfft = _make1("irfft")
+hfft = _make1("hfft")
+ihfft = _make1("ihfft")
+fft2 = _make2("fft2")
+ifft2 = _make2("ifft2")
+rfft2 = _make2("rfft2")
+irfft2 = _make2("irfft2")
+fftn = _maken("fftn")
+ifftn = _maken("ifftn")
+rfftn = _maken("rfftn")
+irfftn = _maken("irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return _wrap(x, jnp.fft.fftshift(_unwrap(x), axes=axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return _wrap(x, jnp.fft.ifftshift(_unwrap(x), axes=axes))

@@ -141,15 +141,18 @@ def save(layer, path, input_spec=None, example_inputs=None):
     meta["keys"] = list(params)
     if example_inputs is not None:
         arr_args = traced._unwrap(tuple(example_inputs))
+        # export for BOTH platforms so a TPU-saved artifact serves on CPU
+        # hosts (and vice versa) — the cross-platform predictor scenario
+        exp = jax.export.export(traced._compiled, platforms=["cpu", "tpu"])
         if traced.is_layer:
-            exported = jax.export.export(traced._compiled)(
-                params, buffers, *arr_args)
+            exported = exp(params, buffers, *arr_args)
         else:
-            exported = jax.export.export(traced._compiled)(*arr_args)
+            exported = exp(*arr_args)
         with open(path + ".pdmodel", "wb") as f:
             f.write(bytes(exported.serialize()))
         with open(path + ".stablehlo", "w") as f:
-            f.write(traced.stablehlo(*example_inputs))
+            # reuse the exported module text — no second trace/lower pass
+            f.write(exported.mlir_module())
         meta["has_program"] = True
         meta["program_takes_state"] = traced.is_layer
     with open(path + ".pdmodel.json", "w") as f:

@@ -230,7 +230,7 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
              "down_w": bp["down_w"], "down_b": bp["down_b"]},
             h, top_k=cfg.moe_top_k,
             capacity_factor=cfg.moe_capacity_factor)
-        return x + y, aux
+        return x + _dropout(y, cfg.dropout, k_ffn), aux
     h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
     h = jax.nn.gelu(h, approximate=True)
     h = jnp.einsum("bsf,fd->bsd", h, bp["down_w"]) + bp["down_b"]

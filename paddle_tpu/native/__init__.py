@@ -1,0 +1,64 @@
+"""Native (C++) runtime components, built lazily with the toolchain in
+the image (g++; no pybind11 — ctypes bindings).
+
+Currently: the TCPStore rendezvous server/client (tcp_store.cpp) — the
+reference keeps this native too (distributed/store/tcp_store.cc).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def _build(src, out):
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           src, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr[-2000:]}")
+
+
+def load_tcp_store_lib():
+    """Compile (if stale) and dlopen the TCPStore library."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_DIR, "tcp_store.cpp")
+        out = os.path.join(_DIR, "_libtcpstore.so")
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            _build(src, out)
+        lib = ctypes.CDLL(out)
+        lib.ts_server_start.restype = ctypes.c_void_p
+        lib.ts_server_start.argtypes = [ctypes.c_int]
+        lib.ts_server_port.restype = ctypes.c_int
+        lib.ts_server_port.argtypes = [ctypes.c_void_p]
+        lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ts_client_connect.restype = ctypes.c_void_p
+        lib.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_double]
+        lib.ts_client_close.argtypes = [ctypes.c_void_p]
+        lib.ts_set.restype = ctypes.c_int
+        lib.ts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_long]
+        lib.ts_get.restype = ctypes.c_long
+        lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_long]
+        lib.ts_add.restype = ctypes.c_longlong
+        lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_longlong]
+        lib.ts_wait.restype = ctypes.c_long
+        lib.ts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_long]
+        lib.ts_delete.restype = ctypes.c_int
+        lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _LIB = lib
+        return lib

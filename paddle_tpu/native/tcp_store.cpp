@@ -1,0 +1,307 @@
+// TCPStore — native rendezvous key-value store.
+//
+// Reference parity: paddle/fluid/distributed/store/tcp_store.cc (the KV
+// store ProcessGroup bootstrap rides on) — re-implemented from the
+// interface contract (set/get/add/wait with a blocking master), not
+// translated.  C API surface for ctypes (no pybind11 in the image).
+//
+// Protocol: length-prefixed frames.
+//   request : u8 op | u32 klen | key | u64 vlen | value
+//   response: u8 status | u64 vlen | value
+// ops: 0=SET 1=GET 2=ADD(value=i64 LE) 3=WAIT 4=DELETE 5=PING
+// status: 0=ok 1=missing (GET/WAIT timeout handled client-side by retry)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool stopping = false;
+
+  void handle(int fd) {
+    for (;;) {
+      uint8_t op;
+      uint32_t klen;
+      uint64_t vlen;
+      if (!recv_all(fd, &op, 1) || !recv_all(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !recv_all(fd, &key[0], klen)) break;
+      if (!recv_all(fd, &vlen, 8)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !recv_all(fd, &val[0], vlen)) break;
+
+      uint8_t status = 0;
+      std::string out;
+      switch (op) {
+        case 0: {  // SET
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = val;
+          cv.notify_all();
+          break;
+        }
+        case 1: {  // GET
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          if (it == kv.end()) {
+            status = 1;
+          } else {
+            out = it->second;
+          }
+          break;
+        }
+        case 2: {  // ADD: value is i64 delta; returns new value as i64
+          int64_t delta = 0;
+          std::memcpy(&delta, val.data(), sizeof(int64_t));
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end())
+            std::memcpy(&cur, it->second.data(), sizeof(int64_t));
+          cur += delta;
+          std::string enc(sizeof(int64_t), '\0');
+          std::memcpy(&enc[0], &cur, sizeof(int64_t));
+          kv[key] = enc;
+          out = enc;
+          cv.notify_all();
+          break;
+        }
+        case 3: {  // WAIT (server blocks until present or shutdown)
+          std::unique_lock<std::mutex> g(mu);
+          cv.wait(g, [&] { return stopping || kv.count(key) > 0; });
+          if (stopping || kv.count(key) == 0) {
+            status = 1;
+          } else {
+            out = kv[key];
+          }
+          break;
+        }
+        case 4: {  // DELETE
+          std::lock_guard<std::mutex> g(mu);
+          kv.erase(key);
+          break;
+        }
+        case 5:  // PING
+          out = "pong";
+          break;
+        default:
+          status = 1;
+      }
+      uint64_t olen = out.size();
+      if (!send_all(fd, &status, 1) || !send_all(fd, &olen, 8)) break;
+      if (olen && !send_all(fd, out.data(), olen)) break;
+    }
+    ::close(fd);
+  }
+
+  bool start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) != 0) return false;
+    accept_thread = std::thread([this] {
+      for (;;) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;  // listen_fd closed on stop
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        workers.emplace_back(&Server::handle, this, fd);
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      stopping = true;
+    }
+    cv.notify_all();
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& w : workers)
+      if (w.joinable()) w.detach();  // blocked clients own their sockets
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  bool connect_to(const char* host, int port, double timeout_s) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    for (;;) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd);
+      fd = -1;
+      if (std::chrono::steady_clock::now() > deadline) return false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  // returns status (0 ok, 1 missing, 2 io-error); out filled on ok
+  int request(uint8_t op, const std::string& key, const std::string& val,
+              std::string* out) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t klen = key.size();
+    uint64_t vlen = val.size();
+    if (!send_all(fd, &op, 1) || !send_all(fd, &klen, 4) ||
+        (klen && !send_all(fd, key.data(), klen)) ||
+        !send_all(fd, &vlen, 8) ||
+        (vlen && !send_all(fd, val.data(), vlen)))
+      return 2;
+    uint8_t status;
+    uint64_t olen;
+    if (!recv_all(fd, &status, 1) || !recv_all(fd, &olen, 8)) return 2;
+    out->resize(olen);
+    if (olen && !recv_all(fd, &(*out)[0], olen)) return 2;
+    return status;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_server_start(int port) {
+  auto* s = new Server();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int ts_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void ts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop();
+  delete s;
+}
+
+void* ts_client_connect(const char* host, int port, double timeout_s) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_s)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void ts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+int ts_set(void* h, const char* key, const char* val, long vlen) {
+  std::string out;
+  return static_cast<Client*>(h)->request(
+      0, key, std::string(val, static_cast<size_t>(vlen)), &out);
+}
+
+// caller passes a buffer; returns -1 missing, -2 io error, -3 too small,
+// else the value length
+long ts_get(void* h, const char* key, char* buf, long cap) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(1, key, "", &out);
+  if (st == 1) return -1;
+  if (st != 0) return -2;
+  if (static_cast<long>(out.size()) > cap) return -3;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<long>(out.size());
+}
+
+long long ts_add(void* h, const char* key, long long delta) {
+  std::string enc(sizeof(int64_t), '\0');
+  int64_t d = delta;
+  std::memcpy(&enc[0], &d, sizeof(int64_t));
+  std::string out;
+  int st = static_cast<Client*>(h)->request(2, key, enc, &out);
+  if (st != 0 || out.size() < sizeof(int64_t)) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), sizeof(int64_t));
+  return v;
+}
+
+long ts_wait(void* h, const char* key, char* buf, long cap) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(3, key, "", &out);
+  if (st != 0) return -2;
+  if (static_cast<long>(out.size()) > cap) return -3;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<long>(out.size());
+}
+
+int ts_delete(void* h, const char* key) {
+  std::string out;
+  return static_cast<Client*>(h)->request(4, key, "", &out);
+}
+
+}  // extern "C"

@@ -1,0 +1,105 @@
+"""fft + distribution API tests (parity: paddle.fft / paddle.distribution
+test strategy — numeric comparison against numpy/scipy formulas)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Bernoulli, Beta, Categorical,
+                                     Dirichlet, Normal, Uniform,
+                                     kl_divergence)
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(0).randn(16).astype(np.float32)
+        X = paddle.fft.fft(paddle.to_tensor(x.astype(np.complex64)))
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(np.asarray(back.data).real, x, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(X.data),
+                                   np.fft.fft(x), atol=1e-3)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.RandomState(1).randn(4, 32).astype(np.float32)
+        out = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.fft.rfft(x), atol=1e-3)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).randn(8, 8).astype(np.float32)
+        out = paddle.fft.fftshift(paddle.fft.fft2(
+            paddle.to_tensor(x.astype(np.complex64))))
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.fft.fftshift(np.fft.fft2(x)),
+                                   atol=1e-2)
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(np.asarray(paddle.fft.fftfreq(8, 0.5).data),
+                                   np.fft.fftfreq(8, 0.5), atol=1e-7)
+
+    def test_ortho_norm(self):
+        x = np.random.RandomState(3).randn(16).astype(np.float32)
+        out = paddle.fft.rfft(paddle.to_tensor(x), norm="ortho")
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.fft.rfft(x, norm="ortho"), atol=1e-4)
+
+
+class TestDistribution:
+    def test_normal(self):
+        paddle.seed(0)
+        d = Normal(1.0, 2.0)
+        s = d.sample([20000])
+        arr = np.asarray(s.data)
+        assert abs(arr.mean() - 1.0) < 0.1
+        assert abs(arr.std() - 2.0) < 0.1
+        lp = float(d.log_prob(1.0).data)
+        ref = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(lp, ref, atol=1e-5)
+        ent = float(d.entropy().data)
+        np.testing.assert_allclose(ent, 0.5 + 0.5 * np.log(2 * np.pi)
+                                   + np.log(2.0), atol=1e-5)
+
+    def test_uniform(self):
+        paddle.seed(1)
+        d = Uniform(-1.0, 3.0)
+        arr = np.asarray(d.sample([10000]).data)
+        assert arr.min() >= -1.0 and arr.max() < 3.0
+        assert abs(float(d.log_prob(0.0).data) - np.log(1 / 4)) < 1e-5
+        assert float(d.log_prob(5.0).data) == -np.inf
+
+    def test_categorical(self):
+        paddle.seed(2)
+        d = Categorical(probs=[0.1, 0.2, 0.7])
+        arr = np.asarray(d.sample([20000]).data)
+        freq = np.bincount(arr, minlength=3) / len(arr)
+        np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+        np.testing.assert_allclose(float(d.log_prob(2).data), np.log(0.7),
+                                   atol=1e-5)
+
+    def test_bernoulli(self):
+        paddle.seed(3)
+        d = Bernoulli(probs=0.3)
+        arr = np.asarray(d.sample([20000]).data)
+        assert abs(arr.mean() - 0.3) < 0.02
+        np.testing.assert_allclose(float(d.log_prob(1.0).data), np.log(0.3),
+                                   atol=1e-4)
+
+    def test_beta_dirichlet_shapes(self):
+        paddle.seed(4)
+        b = Beta(2.0, 3.0)
+        assert np.asarray(b.sample([10]).data).shape == (10,)
+        dd = Dirichlet(np.array([1.0, 2.0, 3.0], np.float32))
+        s = np.asarray(dd.sample([6]).data)
+        assert s.shape == (6, 3)
+        np.testing.assert_allclose(s.sum(-1), np.ones(6), atol=1e-5)
+
+    def test_kl_normal(self):
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        kl = float(kl_divergence(p, q).data)
+        ref = np.log(2.0) + (1 + 1) / 8 - 0.5
+        np.testing.assert_allclose(kl, ref, atol=1e-5)
+
+    def test_kl_categorical_nonnegative(self):
+        p = Categorical(probs=[0.2, 0.8])
+        q = Categorical(probs=[0.5, 0.5])
+        assert float(kl_divergence(p, q).data) > 0
+        assert abs(float(kl_divergence(p, p).data)) < 1e-7

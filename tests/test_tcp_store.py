@@ -1,0 +1,85 @@
+"""Native TCPStore tests (reference: tcp_store.cc semantics; the C++
+server/client compile on first use with the image's g++)."""
+import multiprocessing as mp
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore
+
+
+def _wait_worker(port, q):
+    st = TCPStore(port=port, is_master=False, world_size=2)
+    q.put(st.get("late-key", blocking=True))  # blocks until set
+
+
+def _barrier_worker(port, q):
+    st = TCPStore(port=port, is_master=False, world_size=3)
+    st.barrier("b1", timeout=30)
+    q.put(time.time())
+
+
+class TestTCPStore:
+    def test_set_get(self):
+        master = TCPStore(is_master=True, world_size=1)
+        master.set("k", b"hello")
+        assert master.get("k") == b"hello"
+        master.set("k", "text-value")
+        assert master.get("k") == b"text-value"
+
+    def test_get_nonblocking_missing(self):
+        master = TCPStore(is_master=True, world_size=1)
+        with pytest.raises(KeyError):
+            master.get("nope", blocking=False)
+
+    def test_add_counter(self):
+        master = TCPStore(is_master=True, world_size=1)
+        assert master.add("c", 1) == 1
+        assert master.add("c", 5) == 6
+        assert master.add("c", -2) == 4
+
+    def test_second_client_sees_master_data(self):
+        master = TCPStore(is_master=True, world_size=2)
+        client = TCPStore(port=master.port, is_master=False, world_size=2)
+        master.set("from_master", b"x")
+        assert client.get("from_master") == b"x"
+        client.set("from_client", b"y")
+        assert master.get("from_master") == b"x"
+        assert master.get("from_client") == b"y"
+
+    def test_blocking_wait_across_processes(self):
+        master = TCPStore(is_master=True, world_size=2)
+        port = master.port
+
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_wait_worker, args=(port, q))
+        p.start()
+        time.sleep(0.5)           # worker is (very likely) blocked in wait
+        master.set("late-key", b"released")
+        assert q.get(timeout=30) == b"released"
+        p.join(timeout=10)
+        assert p.exitcode == 0
+
+    def test_barrier_across_processes(self):
+        master = TCPStore(is_master=True, world_size=3)
+        port = master.port
+
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_barrier_worker, args=(port, q))
+                 for _ in range(2)]
+        for p in procs:
+            p.start()
+        time.sleep(0.5)
+        t_release = time.time()
+        master.barrier("b1", timeout=30)   # third participant releases all
+        times = [q.get(timeout=30) for _ in procs]
+        for p in procs:
+            p.join(timeout=10)
+        assert all(t >= t_release - 0.2 for t in times)
+
+    def test_connect_timeout(self):
+        with pytest.raises(TimeoutError):
+            TCPStore(host="127.0.0.1", port=1, is_master=False,
+                     world_size=1, timeout=0.5)
