@@ -27,6 +27,7 @@ from .tensor import Tensor
 __all__ = ["register_op", "get_op", "list_ops", "OP_REGISTRY"]
 
 OP_REGISTRY: dict[str, "OpDef"] = {}
+_static_program = None   # lazily bound module ref (hot dispatch path)
 
 
 class OpDef:
@@ -134,6 +135,14 @@ def _eager_run(op_name, pure_fn, differentiable, args, kwargs):
 
     if flag("check_nan_inf"):
         _check_nan_inf(op_name, outs)
+
+    # static capture: while a Program is under construction
+    # (static.program_guard), append this op to its op list
+    global _static_program
+    if _static_program is None:
+        from ..static import program as _static_program  # noqa: F811
+    if _static_program.current_program() is not None:
+        _static_program.maybe_record(op_name, fn, treedef, leaves, wrapped)
 
     if out_is_tuple:
         return tuple(wrapped)

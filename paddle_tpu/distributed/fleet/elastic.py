@@ -42,8 +42,12 @@ class ElasticManager:
     def __init__(self, store: TCPStore = None, job_id="default", np=1,
                  host=None, heartbeat_interval=0.5, node_timeout=2.0):
         if store is None:
-            endpoint = os.environ.get("PADDLE_ELASTIC_SERVER",
-                                      "127.0.0.1:0")
+            endpoint = os.environ.get("PADDLE_ELASTIC_SERVER")
+            if endpoint is None:
+                raise ValueError(
+                    "ElasticManager needs a shared store: pass store= or "
+                    "set PADDLE_ELASTIC_SERVER=host:port (a private "
+                    "local store would split-brain multi-node jobs)")
             h, p = endpoint.rsplit(":", 1)
             store = TCPStore(host=h, port=int(p), is_master=(int(p) == 0),
                              world_size=np)

@@ -46,29 +46,42 @@ class TCPStore:
         if rc != 0:
             raise RuntimeError(f"TCPStore.set({key!r}) failed rc={rc}")
 
-    def get(self, key: str, blocking=True) -> bytes:
+    def get(self, key: str, blocking=True, timeout=None) -> bytes:
+        """Blocking get POLLS (client-side) rather than using the wire
+        WAIT op: a server-side wait would hold this client's request
+        mutex for its whole duration, deadlocking concurrent users of the
+        same store object (e.g. a heartbeat thread)."""
         import ctypes
 
         buf = ctypes.create_string_buffer(1 << 20)
-        if blocking:
-            n = self._lib.ts_wait(self._client, key.encode(), buf, len(buf))
-        else:
+        deadline = time.time() + (timeout or self.timeout)
+        while True:
             n = self._lib.ts_get(self._client, key.encode(), buf, len(buf))
-            if n == -1:
+            if n >= 0:
+                return buf.raw[:n]
+            if n != -1:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed rc={n}")
+            if not blocking:
                 raise KeyError(key)
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) failed rc={n}")
-        return buf.raw[:n]
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"TCPStore.get({key!r}) timed out after "
+                    f"{timeout or self.timeout}s")
+            time.sleep(0.01)
 
     def add(self, key: str, delta: int = 1) -> int:
-        v = self._lib.ts_add(self._client, key.encode(), int(delta))
-        if v == -1:
-            raise RuntimeError(f"TCPStore.add({key!r}) failed")
-        return int(v)
+        import ctypes
+
+        out = ctypes.c_longlong(0)
+        rc = self._lib.ts_add(self._client, key.encode(), int(delta),
+                              ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed rc={rc}")
+        return int(out.value)
 
     def wait(self, keys, timeout=None):
         for k in (keys if isinstance(keys, (list, tuple)) else [keys]):
-            self.get(k, blocking=True)
+            self.get(k, blocking=True, timeout=timeout)
 
     def delete_key(self, key: str):
         self._lib.ts_delete(self._client, key.encode())
@@ -81,6 +94,7 @@ class TCPStore:
         gen = (n - 1) // self.world_size   # re-usable barrier generations
         target = (gen + 1) * self.world_size
         deadline = time.time() + timeout
+        cur = n
         while True:
             import ctypes
 

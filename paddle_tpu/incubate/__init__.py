@@ -1,0 +1,9 @@
+"""incubate — extension surfaces (parity: python/paddle/incubate/).
+
+Currently: the custom-op API (custom_op), fused-transformer-style layers
+live in nn/layer/transformer.py, MoE in distributed/moe.py.
+"""
+from . import custom_op
+from .custom_op import CustomOpBuilder, custom_op as build_op
+
+__all__ = ["custom_op", "CustomOpBuilder", "build_op"]

@@ -1,0 +1,85 @@
+"""Custom-op API (parity: the reference's PD_BUILD_OP / custom kernel
+registration — paddle/phi/api/ext/, fluid/framework/custom_operator.cc,
+phi/core/custom_kernel.cc, exercised by tests/custom_op/ fixtures).
+
+TPU-native: a custom op is a pure jax function (optionally with a custom
+VJP and/or a Pallas TPU kernel inside).  Registration hangs it off the
+framework dispatch (core.dispatch.register_op), so the new op gets the
+same treatment as built-ins: eager tape capture, Tensor unwrap/wrap,
+jit-traceability.  The C++ path of the reference exists to compile device
+kernels — here Pallas IS the device-kernel path, so the Python-level
+registration is the whole story (no .so build step needed); a C++ HOST
+op can still plug in through ctypes inside the pure function.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import get_op, register_op
+from ..core.tensor import Tensor
+
+__all__ = ["custom_op", "CustomOpBuilder"]
+
+
+def custom_op(name, forward=None, backward=None, differentiable=True):
+    """Register a custom op.
+
+    forward: pure jax function (arrays in → array/tuple out).
+    backward: optional custom gradient rule ``bwd(res, cotangents)`` paired
+      with forward returning ``(out, res)`` — wrapped in jax.custom_vjp the
+      usual way.  Without it, jax AD differentiates the forward directly.
+
+    Returns the eager entry point (also reachable via ops.get_op(name)).
+    Decorator form: ``@custom_op("my_op")``.
+    """
+    if forward is None:
+        return lambda fn: custom_op(name, fn, backward, differentiable)
+
+    pure = forward
+    if backward is not None:
+        fwd = forward
+
+        @jax.custom_vjp
+        def pure(*args):
+            out, _ = fwd(*args)
+            return out
+
+        def _fwd(*args):
+            return fwd(*args)
+
+        pure.defvjp(_fwd, backward)
+
+    return register_op(name, differentiable=differentiable)(pure)
+
+
+class CustomOpBuilder:
+    """Fluent parity shim for PD_BUILD_OP's builder style::
+
+        (CustomOpBuilder("relu6")
+            .set_forward(lambda x: jnp.clip(x, 0, 6))
+            .register())
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self._forward = None
+        self._backward = None
+        self._differentiable = True
+
+    def set_forward(self, fn):
+        self._forward = fn
+        return self
+
+    def set_backward(self, fn):
+        self._backward = fn
+        return self
+
+    def set_differentiable(self, flag):
+        self._differentiable = flag
+        return self
+
+    def register(self):
+        if self._forward is None:
+            raise ValueError(f"custom op {self.name!r} needs set_forward")
+        return custom_op(self.name, self._forward, self._backward,
+                         self._differentiable)

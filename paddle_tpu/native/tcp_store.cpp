@@ -56,6 +56,8 @@ struct Server {
   int port = 0;
   std::thread accept_thread;
   std::vector<std::thread> workers;
+  std::vector<int> client_fds;
+  std::mutex fds_mu;
   std::map<std::string, std::string> kv;
   std::mutex mu;
   std::condition_variable cv;
@@ -93,13 +95,22 @@ struct Server {
           break;
         }
         case 2: {  // ADD: value is i64 delta; returns new value as i64
+          if (val.size() < sizeof(int64_t)) {
+            status = 1;  // malformed delta
+            break;
+          }
           int64_t delta = 0;
           std::memcpy(&delta, val.data(), sizeof(int64_t));
           std::lock_guard<std::mutex> g(mu);
           int64_t cur = 0;
           auto it = kv.find(key);
-          if (it != kv.end())
+          if (it != kv.end()) {
+            if (it->second.size() != sizeof(int64_t)) {
+              status = 1;  // key holds a non-counter value
+              break;
+            }
             std::memcpy(&cur, it->second.data(), sizeof(int64_t));
+          }
           cur += delta;
           std::string enc(sizeof(int64_t), '\0');
           std::memcpy(&enc[0], &cur, sizeof(int64_t));
@@ -158,6 +169,10 @@ struct Server {
         if (fd < 0) break;  // listen_fd closed on stop
         int one = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        {
+          std::lock_guard<std::mutex> g(fds_mu);
+          client_fds.push_back(fd);
+        }
         workers.emplace_back(&Server::handle, this, fd);
       }
     });
@@ -173,8 +188,14 @@ struct Server {
     ::shutdown(listen_fd, SHUT_RDWR);
     ::close(listen_fd);
     if (accept_thread.joinable()) accept_thread.join();
+    {
+      // force every handler out of recv/WAIT so we can JOIN them — the
+      // Server owns mu/cv/kv and must outlive all references to them
+      std::lock_guard<std::mutex> g(fds_mu);
+      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+    }
     for (auto& w : workers)
-      if (w.joinable()) w.detach();  // blocked clients own their sockets
+      if (w.joinable()) w.join();
   }
 };
 
@@ -278,16 +299,20 @@ long ts_get(void* h, const char* key, char* buf, long cap) {
   return static_cast<long>(out.size());
 }
 
-long long ts_add(void* h, const char* key, long long delta) {
+// returns 0 ok (result in *out_value), nonzero on error — the value
+// itself may legitimately be any i64 including -1
+int ts_add(void* h, const char* key, long long delta,
+           long long* out_value) {
   std::string enc(sizeof(int64_t), '\0');
   int64_t d = delta;
   std::memcpy(&enc[0], &d, sizeof(int64_t));
   std::string out;
   int st = static_cast<Client*>(h)->request(2, key, enc, &out);
-  if (st != 0 || out.size() < sizeof(int64_t)) return -1;
+  if (st != 0 || out.size() < sizeof(int64_t)) return st ? st : 2;
   int64_t v;
   std::memcpy(&v, out.data(), sizeof(int64_t));
-  return v;
+  *out_value = v;
+  return 0;
 }
 
 long ts_wait(void* h, const char* key, char* buf, long cap) {

@@ -1,0 +1,149 @@
+"""Sparse tensors (parity: python/paddle/sparse/ + the reference's
+SparseCooTensor/SparseCsrTensor, paddle/phi/core/sparse_*_tensor.h).
+
+TPU-native: COO rides jax.experimental.sparse.BCOO — XLA lowers sparse
+matmul/sddmm-style ops to gather/scatter compute the MXU can chew on.
+CSR is represented as (crows, cols, values) and converted through BCOO
+for compute (the reference likewise converts between formats).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "matmul", "add", "to_dense"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO wrapper over BCOO (dense_tensor zoo row N8)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)   # [ndim, nnz] like paddle
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        assert len(self.shape) == 2
+        bcsr = jsparse.BCSR.from_bcoo(self._bcoo.sort_indices())
+        return SparseCsrTensor.from_bcsr(bcsr)
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._values = _arr(values)
+        self._shape = tuple(shape)
+
+    @classmethod
+    def from_bcsr(cls, bcsr):
+        return cls(bcsr.indptr, bcsr.indices, bcsr.data, bcsr.shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def _bcoo(self):
+        bcsr = jsparse.BCSR((self._values, self._cols, self._crows),
+                            shape=self._shape)
+        return bcsr.to_bcoo()
+
+    def to_dense(self):
+        return Tensor(self._bcoo().todense())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._bcoo())
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor parity: indices [ndim, nnz]."""
+    idx = jnp.asarray(_arr(indices), jnp.int32)
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return SparseCsrTensor(_arr(crows), _arr(cols), vals, shape)
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def matmul(a, b):
+    """sparse @ dense (paddle.sparse.matmul)."""
+    bd = _arr(b)
+    if isinstance(a, SparseCsrTensor):
+        a = a.to_sparse_coo()
+    out = a._bcoo @ bd
+    return Tensor(out)
+
+
+def add(a, b):
+    """sparse + sparse → sparse (same format)."""
+    if isinstance(a, SparseCsrTensor):
+        return add(a.to_sparse_coo(), b.to_sparse_coo()
+                   if isinstance(b, SparseCsrTensor) else b)
+    bb = b._bcoo if isinstance(b, SparseCooTensor) else b._bcoo()
+    summed = jsparse.bcoo_sum_duplicates(_coo_add(a._bcoo, bb))
+    return SparseCooTensor(summed)
+
+
+def _coo_add(x, y):
+    data = jnp.concatenate([x.data, y.data])
+    idx = jnp.concatenate([x.indices, y.indices], axis=0)
+    return jsparse.BCOO((data, idx), shape=x.shape)

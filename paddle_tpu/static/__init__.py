@@ -1,0 +1,80 @@
+"""paddle.static — the Program/Executor facade (reference parity:
+python/paddle/static/ over fluid/framework.py Program:4777 +
+fluid/executor.py Executor:619).
+
+On TPU the Executor compiles the captured op-list Program with jax.jit —
+instruction scheduling/streams/GC are XLA's (the InterpreterCore jobs);
+the Program remains a REWRITABLE IR for passes (static/passes.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import passes
+from .passes import new_pass
+from .program import (Program, current_program, data, default_main_program,
+                      program_guard)
+
+__all__ = ["Program", "program_guard", "default_main_program", "data",
+           "Executor", "CompiledProgram", "new_pass", "passes"]
+
+
+class Executor:
+    """Compile-and-run a Program (fluid/executor.py:619 Executor.run)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            use_passes=("dead_code_elimination",)):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_vids = []
+        for t in fetch_list:
+            vid = program.lookup(t)
+            if vid is None:
+                raise ValueError("fetch target was not produced by this "
+                                 "program")
+            fetch_vids.append(vid)
+
+        key = (id(program), len(program.ops), tuple(fetch_vids),
+               tuple(sorted(feed)), tuple(use_passes or ()))
+        entry = self._cache.get(key)
+        if entry is None:
+            prog = program.clone()
+            for name in (use_passes or ()):
+                new_pass(name).apply(prog, fetch_vids)
+
+            def fn(feed_arrays, param_arrays):
+                return prog.replay(feed_arrays, fetch_vids, param_arrays)
+
+            entry = (jax.jit(fn), prog)
+            self._cache[key] = entry
+        runner, prog = entry
+        # params enter as jit INPUTS, so weight updates between runs are
+        # visible (the reference's scope-variable semantics)
+        out = runner(
+            {k: jnp.asarray(v.data if isinstance(v, Tensor) else v)
+             for k, v in feed.items()},
+            [t.data for t in prog.param_refs()])
+        return [np.asarray(o) for o in out]
+
+    def close(self):
+        self._cache.clear()
+
+
+class CompiledProgram:
+    """Parity shim for fluid.compiler.CompiledProgram: a Program bundled
+    with its pass pipeline."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def __getattr__(self, item):
+        return getattr(self.program, item)

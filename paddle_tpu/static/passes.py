@@ -1,0 +1,111 @@
+"""Program passes (reference parity: framework/ir/pass.h:53 ``Pass`` +
+REGISTER_PASS:317, and the python pass registry distributed/passes/
+pass_base.py).
+
+Passes rewrite the Program's op list in place.  The reference ships ~150
+graph-fusion passes whose work XLA does automatically here; the ones that
+remain MEANINGFUL on TPU are program-level rewrites ahead of the
+compiler: dead-op elimination (shrinks the traced program) and bf16
+auto-cast (the static-AMP pass, contrib/mixed_precision analog).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from .program import OpDesc, Program, _ParamRef, _VarRef
+
+__all__ = ["Pass", "register_pass", "new_pass", "PASS_REGISTRY",
+           "DeadCodeEliminationPass", "AmpBf16Pass"]
+
+PASS_REGISTRY: dict[str, type] = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.pass_name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name, attrs=None):
+    cls = PASS_REGISTRY[name]
+    return cls(**(attrs or {}))
+
+
+class Pass:
+    pass_name = "base"
+
+    def apply(self, program: Program, fetch_vids=()):
+        raise NotImplementedError
+
+
+@register_pass("dead_code_elimination")
+class DeadCodeEliminationPass(Pass):
+    """Drop ops whose outputs reach neither a fetch target nor another
+    live op (prune.cc / graph DCE analog)."""
+
+    def apply(self, program, fetch_vids=()):
+        live = set(fetch_vids)
+        kept = []
+        for op in reversed(program.ops):
+            if any(v in live for v in op.out_vids):
+                kept.append(op)
+                live.update(op.input_vids())
+        removed = len(program.ops) - len(kept)
+        program.ops = list(reversed(kept))
+        return removed
+
+
+@register_pass("amp_bf16")
+class AmpBf16Pass(Pass):
+    """Static AMP: wrap matmul-class ops so their floating inputs compute
+    in bf16 and the result returns in the original dtype (the reference's
+    fluid/contrib/mixed_precision program rewrite; white-list style)."""
+
+    WHITE_LIST = {"matmul", "mm", "bmm", "einsum", "conv2d", "linear"}
+
+    def __init__(self, white_list=None):
+        self.white = set(white_list) if white_list else set(self.WHITE_LIST)
+
+    def apply(self, program, fetch_vids=()):
+        count = 0
+        for op in program.ops:
+            if op.name not in self.white:
+                continue
+            op.pure_fn = self._wrap(op.pure_fn)
+            count += 1
+        return count
+
+    @staticmethod
+    def _wrap(fn):
+        if getattr(fn, "_amp_bf16_wrapped", False):
+            return fn
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            def cast_in(x):
+                if hasattr(x, "dtype") and x.dtype == jnp.float32:
+                    return x.astype(jnp.bfloat16)
+                return x
+
+            import jax
+
+            out_dtype = None
+            for a in jax.tree_util.tree_leaves(args):
+                if hasattr(a, "dtype") and a.dtype == jnp.float32:
+                    out_dtype = jnp.float32
+            args = jax.tree_util.tree_map(cast_in, args)
+            out = fn(*args, **kwargs)
+            if out_dtype is not None:
+                out = jax.tree_util.tree_map(
+                    lambda o: o.astype(out_dtype)
+                    if hasattr(o, "dtype") and o.dtype == jnp.bfloat16
+                    else o, out)
+            return out
+
+        wrapped._amp_bf16_wrapped = True
+        return wrapped

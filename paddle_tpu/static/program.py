@@ -1,0 +1,227 @@
+"""Program IR — the rewritable op-list program (reference parity:
+framework.proto ProgramDesc/OpDesc/VarDesc + python Program/Block
+(fluid/framework.py:4777,3199) + append_op capture).
+
+TPU-native design: the reference builds programs by appending OpDescs
+from python and compiles them with C++ executors.  Here the SAME eager op
+calls are captured: while a Program is being built (program_guard), every
+dispatched op ALSO appends an OpDesc recording its pure function, its
+input variables (placeholders or earlier outputs), and its captured
+parameters (live Tensor references, so optimizer updates are visible at
+run time).  The op list is a real IR: passes rewrite it
+(static/passes.py), Executor replays it under jax.jit.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "current_program", "data", "OpDesc", "VarDesc"]
+
+_counter = itertools.count()
+
+
+class VarDesc:
+    __slots__ = ("vid", "name", "shape", "dtype", "is_feed")
+
+    def __init__(self, vid, name, shape, dtype, is_feed=False):
+        self.vid = vid
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.is_feed = is_feed
+
+    def __repr__(self):
+        kind = "feed" if self.is_feed else "var"
+        return f"{kind} {self.name}: {self.dtype}{list(self.shape)}"
+
+
+class _VarRef:
+    """Marker replacing a Tensor leaf in an OpDesc's arg structure."""
+
+    __slots__ = ("vid",)
+
+    def __init__(self, vid):
+        self.vid = vid
+
+
+class _ParamRef:
+    """A leaf bound to a LIVE Tensor (layer parameter): its value is read
+    at run time, so training updates flow into subsequent runs."""
+
+    __slots__ = ("tensor",)
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+
+
+class OpDesc:
+    __slots__ = ("name", "pure_fn", "treedef", "leaves", "out_vids")
+
+    def __init__(self, name, pure_fn, treedef, leaves, out_vids):
+        self.name = name
+        self.pure_fn = pure_fn
+        self.treedef = treedef
+        self.leaves = leaves          # list of _VarRef/_ParamRef/literal
+        self.out_vids = out_vids
+
+    def input_vids(self):
+        return [l.vid for l in self.leaves if isinstance(l, _VarRef)]
+
+    def __repr__(self):
+        ins = ",".join(f"v{v}" for v in self.input_vids())
+        outs = ",".join(f"v{v}" for v in self.out_vids)
+        return f"{self.name}({ins}) -> {outs}"
+
+
+class Program:
+    """An ordered op list over named variables (ProgramDesc analog)."""
+
+    def __init__(self):
+        self.vars: dict[int, VarDesc] = {}
+        self.ops: list[OpDesc] = []
+        self._tensor_vids: dict[int, int] = {}   # id(Tensor) -> vid
+        self._feed_names: dict[str, int] = {}
+        # strong refs to every tensor we keyed by id(): CPython reuses
+        # addresses after GC, which would miswire lookup()
+        self._keepalive: list = []
+
+    # ---------------------------------------------------------- building
+    def add_feed(self, name, shape, dtype):
+        vid = next(_counter)
+        self.vars[vid] = VarDesc(vid, name, shape, dtype, is_feed=True)
+        self._feed_names[name] = vid
+        concrete = [1 if (d is None or d < 0) else d for d in shape]
+        t = Tensor(jnp.zeros(concrete, dtype))
+        self._tensor_vids[id(t)] = vid
+        self._keepalive.append(t)
+        return t
+
+    def lookup(self, tensor):
+        return self._tensor_vids.get(id(tensor))
+
+    def record(self, op_name, pure_fn, treedef, leaves, out_tensors):
+        enc = []
+        for leaf in leaves:
+            if isinstance(leaf, Tensor):
+                vid = self.lookup(leaf)
+                enc.append(_VarRef(vid) if vid is not None
+                           else _ParamRef(leaf))
+            else:
+                enc.append(leaf)
+        out_vids = []
+        for t in out_tensors:
+            vid = next(_counter)
+            self.vars[vid] = VarDesc(vid, f"tmp_{vid}", t.data.shape,
+                                     str(t.data.dtype))
+            self._tensor_vids[id(t)] = vid
+            self._keepalive.append(t)
+            out_vids.append(vid)
+        self.ops.append(OpDesc(op_name, pure_fn, treedef, enc, out_vids))
+
+    # ----------------------------------------------------------- replay
+    def param_refs(self):
+        """The live parameter Tensors this program reads, in first-use
+        order — the Executor passes their CURRENT values as jit inputs so
+        training updates are visible across runs (scope semantics)."""
+        refs, seen = [], set()
+        for op in self.ops:
+            for leaf in op.leaves:
+                if isinstance(leaf, _ParamRef) and id(leaf.tensor) not in seen:
+                    seen.add(id(leaf.tensor))
+                    refs.append(leaf.tensor)
+        return refs
+
+    def replay(self, feed_arrays, fetch_vids, param_arrays=None):
+        """Execute the op list: feed name→array, return fetch values.
+        Pure in the feeds + params (jit-friendly when param_arrays are
+        passed as traced inputs)."""
+        values = {self._feed_names[k]: jnp.asarray(v)
+                  for k, v in feed_arrays.items()}
+        pidx = ({id(t): i for i, t in enumerate(self.param_refs())}
+                if param_arrays is not None else None)
+
+        def resolve(leaf):
+            if isinstance(leaf, _VarRef):
+                return values[leaf.vid]
+            if isinstance(leaf, _ParamRef):
+                if pidx is not None:
+                    return param_arrays[pidx[id(leaf.tensor)]]
+                return leaf.tensor.data
+            return leaf
+
+        for op in self.ops:
+            full = [resolve(l) for l in op.leaves]
+            args, kwargs = jax.tree_util.tree_unflatten(op.treedef, full)
+            out = op.pure_fn(*args, **kwargs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for vid, o in zip(op.out_vids, outs):
+                values[vid] = o
+        return [values[v] for v in fetch_vids]
+
+    # ------------------------------------------------------------- intro
+    def to_string(self):
+        lines = [f"program ({len(self.ops)} ops, {len(self.vars)} vars)"]
+        for v in self.vars.values():
+            if v.is_feed:
+                lines.append(f"  {v!r}")
+        for op in self.ops:
+            lines.append(f"  {op!r}")
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.vars = dict(self.vars)
+        # deep-copy OpDescs: passes mutate pure_fn in place and must not
+        # leak their rewrites into the original program
+        p.ops = [OpDesc(o.name, o.pure_fn, o.treedef, list(o.leaves),
+                        list(o.out_vids)) for o in self.ops]
+        p._tensor_vids = dict(self._tensor_vids)
+        p._feed_names = dict(self._feed_names)
+        p._keepalive = list(self._keepalive)
+        return p
+
+
+_default_main = Program()
+_stack: list[Program] = []
+
+
+def default_main_program():
+    return _default_main
+
+
+def current_program():
+    return _stack[-1] if _stack else None
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Capture subsequently dispatched ops into ``main_program``."""
+    _stack.append(main_program)
+    try:
+        yield
+    finally:
+        _stack.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed variable (reference: paddle.static.data)."""
+    prog = current_program() or _default_main
+    return prog.add_feed(name, shape, dtype)
+
+
+def maybe_record(op_name, pure_fn, treedef, leaves, out_tensors):
+    """Dispatch hook: called by core.dispatch on every eager op."""
+    prog = current_program()
+    if prog is not None:
+        prog.record(op_name, pure_fn, treedef, leaves, out_tensors)

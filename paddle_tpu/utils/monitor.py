@@ -1,0 +1,87 @@
+"""Runtime counters (parity: paddle/fluid/platform/monitor.h:77
+``StatRegistry`` + the STAT_ADD/STAT_GET macros, plus memory/stats.h's
+per-stat peaks).
+
+Host-side registry: device-side memory stats come from
+jax.local_devices()[0].memory_stats() and are surfaced through the same
+API (the reference's DEVICE_MEMORY_STAT_* reads the allocator; ours reads
+PJRT's).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
+           "device_memory_stats"]
+
+
+class _Stat:
+    __slots__ = ("value", "peak")
+
+    def __init__(self):
+        self.value = 0
+        self.peak = 0
+
+
+class StatRegistry:
+    """Named integer counters with peaks (monitor.h:77)."""
+
+    def __init__(self):
+        self._stats: dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name, delta):
+        with self._lock:
+            s = self._stats.setdefault(name, _Stat())
+            s.value += int(delta)
+            s.peak = max(s.peak, s.value)
+            return s.value
+
+    def get(self, name):
+        with self._lock:
+            s = self._stats.get(name)
+            return s.value if s else 0
+
+    def peak(self, name):
+        with self._lock:
+            s = self._stats.get(name)
+            return s.peak if s else 0
+
+    def reset(self, name=None):
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def stats(self):
+        with self._lock:
+            return {k: (s.value, s.peak) for k, s in self._stats.items()}
+
+
+_default = StatRegistry()
+
+
+def stat_add(name, delta=1):
+    """STAT_ADD analog on the process-wide registry."""
+    return _default.add(name, delta)
+
+
+def stat_get(name):
+    return _default.get(name)
+
+
+def stat_reset(name=None):
+    _default.reset(name)
+
+
+def device_memory_stats(device=None):
+    """PJRT memory stats for a device (allocator stats analog); {} when
+    the backend does not report them."""
+    import jax
+
+    d = device if device is not None else jax.local_devices()[0]
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}

@@ -1,0 +1,195 @@
+"""Tests: utils (monitor/logging), profiler summary, sparse, custom ops."""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestMonitor:
+    def test_stat_registry(self):
+        from paddle_tpu.utils import StatRegistry
+
+        r = StatRegistry()
+        assert r.add("mem", 100) == 100
+        assert r.add("mem", -40) == 60
+        assert r.peak("mem") == 100
+        assert r.get("mem") == 60
+        r.reset("mem")
+        assert r.get("mem") == 0
+
+    def test_global_stats(self):
+        from paddle_tpu.utils import stat_add, stat_get, stat_reset
+
+        stat_reset()
+        stat_add("steps")
+        stat_add("steps")
+        assert stat_get("steps") == 2
+
+    def test_device_memory_stats_shape(self):
+        from paddle_tpu.utils import device_memory_stats
+
+        stats = device_memory_stats()
+        assert isinstance(stats, dict)
+
+
+class TestLogging:
+    def test_rank_in_records(self, capsys):
+        import os
+
+        from paddle_tpu.utils.log_util import get_logger
+
+        os.environ["PADDLE_TRAINER_ID"] = "3"
+        try:
+            log = get_logger("pt_test", level=logging.INFO)
+            log.info("hello")
+            err = capsys.readouterr().err
+            assert "[rank 3]" in err and "hello" in err
+        finally:
+            del os.environ["PADDLE_TRAINER_ID"]
+
+    def test_vlog_gated(self, capsys):
+        from paddle_tpu.utils.log_util import vlog
+
+        vlog(3, "should not appear")
+        assert "should not appear" not in capsys.readouterr().err
+
+
+class TestProfilerSummary:
+    def test_summary_table(self):
+        import time
+
+        from paddle_tpu.profiler.profiler import Profiler, RecordEvent
+
+        p = Profiler(with_device=False)
+        p.start()
+        for _ in range(3):
+            with RecordEvent("op_a"):
+                time.sleep(0.002)
+        with RecordEvent("op_b"):
+            time.sleep(0.001)
+        p.stop()
+        table = p.summary()
+        lines = table.splitlines()
+        assert "Name" in lines[0] and "Calls" in lines[0]
+        assert any("op_a" in l and " 3 " in l for l in lines)
+        assert any("op_b" in l for l in lines)
+        # op_a total > op_b total => sorted first
+        assert lines[1].startswith("op_a")
+
+    def test_chrome_export(self, tmp_path):
+        import json
+
+        from paddle_tpu.profiler.profiler import Profiler, RecordEvent
+
+        p = Profiler(with_device=False)
+        p.start()
+        with RecordEvent("evt"):
+            pass
+        p.stop()
+        out = tmp_path / "trace.json"
+        p.export(str(out))
+        data = json.loads(out.read_text())
+        events = data["traceEvents"] if isinstance(data, dict) else data
+        assert any(e.get("name") == "evt" for e in events)
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        import paddle_tpu.sparse as sp
+
+        dense = np.zeros((4, 5), np.float32)
+        dense[0, 1] = 2.0
+        dense[3, 4] = -1.0
+        idx = np.array([[0, 3], [1, 4]])
+        coo = sp.sparse_coo_tensor(idx, np.array([2.0, -1.0], np.float32),
+                                   shape=(4, 5))
+        assert coo.nnz == 2
+        np.testing.assert_allclose(np.asarray(coo.to_dense().data), dense)
+
+    def test_coo_matmul(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(0)
+        dense = (rng.rand(6, 4) > 0.7).astype(np.float32) * rng.rand(6, 4)
+        rows, cols = np.nonzero(dense)
+        coo = sp.sparse_coo_tensor(np.stack([rows, cols]),
+                                   dense[rows, cols].astype(np.float32),
+                                   shape=dense.shape)
+        b = rng.randn(4, 3).astype(np.float32)
+        out = sp.matmul(coo, b)
+        np.testing.assert_allclose(np.asarray(out.data), dense @ b,
+                                   atol=1e-5)
+
+    def test_csr_conversion(self):
+        import paddle_tpu.sparse as sp
+
+        dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        rows, cols = np.nonzero(dense)
+        coo = sp.sparse_coo_tensor(np.stack([rows, cols]),
+                                   dense[rows, cols], shape=dense.shape)
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(np.asarray(csr.to_dense().data), dense)
+        np.testing.assert_array_equal(np.asarray(csr.crows().data),
+                                      [0, 1, 3])
+
+    def test_sparse_add(self):
+        import paddle_tpu.sparse as sp
+
+        a = sp.sparse_coo_tensor([[0, 1], [0, 1]],
+                                 np.array([1.0, 2.0], np.float32), (2, 2))
+        b = sp.sparse_coo_tensor([[0, 1], [0, 0]],
+                                 np.array([5.0, 7.0], np.float32), (2, 2))
+        out = sp.add(a, b)
+        np.testing.assert_allclose(np.asarray(out.to_dense().data),
+                                   [[6.0, 0.0], [7.0, 2.0]])
+
+
+class TestCustomOp:
+    def test_register_and_call(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate import build_op
+
+        my = build_op("test_relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+        out = my(paddle.to_tensor(np.array([-1.0, 3.0, 9.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.data), [0.0, 3.0, 6.0])
+
+    def test_autograd_through_custom_op(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate import build_op
+
+        sq = build_op("test_square", lambda x: x * x)
+        x = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+        x.stop_gradient = False
+        y = sq(x).sum()
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.data), [4.0, -6.0])
+
+    def test_custom_vjp(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate import custom_op
+
+        # forward returns (out, residuals); backward gets (res, cot)
+        op = custom_op.custom_op(
+            "test_scaled_id",
+            forward=lambda x: (x * 3.0, None),
+            backward=lambda res, g: (g * 100.0,))  # deliberately wrong grad
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        op(x).sum().backward()
+        # the CUSTOM rule must win over autodiff (3.0)
+        np.testing.assert_allclose(np.asarray(x.grad.data), [100.0])
+
+    def test_builder_style(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate import CustomOpBuilder
+
+        op = (CustomOpBuilder("test_cube").set_forward(lambda x: x ** 3)
+              .register())
+        out = op(paddle.to_tensor(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.data), [8.0])
