@@ -1,0 +1,200 @@
+"""Quantization (parity: python/paddle/fluid/contrib/slim/quantization —
+QAT fake-quant insertion + PTQ scale collection; the reference rewrites
+programs to insert fake_quantize/dequantize ops, here fake-quant is a
+differentiable (straight-through) jax function wrapped around the
+quantized layers' compute).
+
+TPU note: int8 inference on TPU rides XLA's native int8 matmul; training
+simulation (QAT) and scale calibration (PTQ) are the framework's job and
+are implemented here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["fake_quant", "QuantizedLinear", "QAT", "PTQ",
+           "quant_scales"]
+
+
+@jax.custom_vjp
+def fake_quant(x, scale, bits=8):
+    """Symmetric fake quantization with a straight-through gradient
+    (reference: fake_quantize_dequantize_moving_average_abs_max)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, bits=8):
+    return fake_quant(x, scale, bits), (x, scale, bits)
+
+
+def _fq_bwd(res, g):
+    x, scale, bits = res
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    inside = (jnp.abs(x) <= s).astype(g.dtype)   # STE inside the range
+    return g * inside, jnp.zeros_like(scale), None
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+from ..core.dispatch import register_op  # noqa: E402
+
+_fake_quant_op = register_op("fake_quant")(fake_quant)
+
+
+class _AbsMax:
+    """Running abs-max over ALL observed batches (PTQ calibration —
+    outliers in any batch must widen the range)."""
+
+    def __init__(self):
+        self.scale = None
+
+    def update(self, arr):
+        cur = float(jnp.max(jnp.abs(arr)))
+        self.scale = cur if self.scale is None else max(self.scale, cur)
+        return self.scale
+
+
+class _MovingAbsMax:
+    """abs-max scale tracker (moving_average_abs_max semantics)."""
+
+    def __init__(self, momentum=0.9):
+        self.momentum = momentum
+        self.scale = None
+
+    def update(self, arr):
+        cur = float(jnp.max(jnp.abs(arr)))
+        if self.scale is None:
+            self.scale = cur
+        else:
+            self.scale = self.momentum * self.scale \
+                + (1 - self.momentum) * cur
+        return self.scale
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weights + activations (QAT module).
+    Wraps an existing Linear, sharing its parameters."""
+
+    def __init__(self, linear, weight_bits=8, activation_bits=8,
+                 momentum=0.9):
+        super().__init__()
+        self.inner = linear
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._w_scale = _MovingAbsMax(momentum)
+        self._a_scale = _MovingAbsMax(momentum)
+
+    def forward(self, x):
+        from .. import ops
+
+        xv = x.data if isinstance(x, Tensor) else x
+        w = self.inner.weight
+        if not isinstance(xv, jax.core.Tracer):
+            self._a_scale.update(xv)
+            self._w_scale.update(w.data)
+        a_s = jnp.asarray(self._a_scale.scale or 1.0, jnp.float32)
+        w_s = jnp.asarray(self._w_scale.scale or 1.0, jnp.float32)
+        xq = _fake_quant_op(x if isinstance(x, Tensor) else Tensor(xv),
+                            Tensor(a_s), bits=self.activation_bits)
+        wq = _fake_quant_op(w, Tensor(w_s), bits=self.weight_bits)
+        out = ops.matmul(xq, wq)
+        if self.inner.bias is not None:
+            out = ops.add(out, self.inner.bias)
+        return out
+
+    def scales(self):
+        return {"weight": self._w_scale.scale,
+                "activation": self._a_scale.scale}
+
+
+class QAT:
+    """Quantization-aware training transform (reference:
+    paddle.quantization QAT / ImperativeQuantAware.quantize): swaps every
+    Linear in a model for a QuantizedLinear sharing its params."""
+
+    def __init__(self, weight_bits=8, activation_bits=8):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def quantize(self, model):
+        from ..nn.layer.common import Linear
+
+        def swap(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, Linear):
+                    layer._sub_layers[name] = QuantizedLinear(
+                        sub, self.weight_bits, self.activation_bits)
+                else:
+                    swap(sub)
+
+        swap(model)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches, collect
+    abs-max activation scales per observed layer (reference PTQ
+    calibrate + convert)."""
+
+    def __init__(self, bits=8):
+        self.bits = bits
+        self._observers = {}
+
+    def quantize(self, model):
+        from ..nn.layer.common import Linear
+
+        def hook_for(name):
+            def hook(layer, inputs, output):
+                arr = inputs[0].data if isinstance(inputs[0], Tensor) \
+                    else inputs[0]
+                obs = self._observers.setdefault(name, _AbsMax())
+                obs.update(arr)
+
+            return hook
+
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, Linear):
+                sub.register_forward_post_hook(hook_for(name))
+        return model
+
+    def scales(self):
+        return {k: o.scale for k, o in self._observers.items()}
+
+    def convert(self, model):
+        """Swap calibrated Linears for QuantizedLinears with the
+        collected scales frozen in."""
+        from ..nn.layer.common import Linear
+
+        def swap(layer, prefix=""):
+            for name, sub in list(layer._sub_layers.items()):
+                full = f"{prefix}.{name}" if prefix else name
+                if isinstance(sub, Linear):
+                    q = QuantizedLinear(sub, self.bits, self.bits)
+                    if full in self._observers:
+                        q._a_scale.scale = self._observers[full].scale
+                    q._w_scale.update(sub.weight.data)
+                    layer._sub_layers[name] = q
+                else:
+                    swap(sub, full)
+
+        swap(model)
+        return model
+
+
+def quant_scales(model):
+    """Collect scales from every QuantizedLinear in a model."""
+    out = {}
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, QuantizedLinear):
+            out[name] = sub.scales()
+    return out

@@ -25,23 +25,54 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.group = group
+        self.comm_buffer_size = comm_buffer_size
         self.find_unused_parameters = find_unused_parameters
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    def _grad_buckets(self):
+        """Group grads into ~comm_buffer_size MB same-dtype buckets — the
+        Reducer's bucketing (imperative/reducer.cc:126): one fused
+        allreduce per bucket instead of one per parameter."""
+        limit = self.comm_buffer_size * 1024 * 1024
+        buckets, cur, cur_bytes, cur_dtype = [], [], 0, None
+        for p in self._layers.parameters():
+            if p.grad is None or p.stop_gradient:
+                continue
+            g = p.grad.data
+            if cur and (g.dtype != cur_dtype or cur_bytes >= limit):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_dtype = g.dtype
+            cur_bytes += g.size * g.dtype.itemsize
+        if cur:
+            buckets.append(cur)
+        return buckets
 
     def apply_collective_grads(self):
         """Reducer analog: AVERAGE grads across the dp group (reference
         DataParallel divides by nranks).  group=None = the world group:
         under the launcher that is all processes."""
         import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
 
         n = self.group.nranks if self.group else jax.process_count()
-        for p in self._layers.parameters():
-            if p.grad is not None and not p.stop_gradient:
-                all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)
-                if n > 1:
-                    p.grad.data = p.grad.data / n
+        for bucket in self._grad_buckets():
+            flat = jnp.concatenate(
+                [p.grad.data.reshape(-1) for p in bucket])
+            t = Tensor(flat)
+            all_reduce(t, op=ReduceOp.SUM, group=self.group)
+            flat = t.data / n if n > 1 else t.data
+            off = 0
+            for p in bucket:
+                size = p.grad.data.size
+                p.grad.data = flat[off:off + size].reshape(
+                    p.grad.data.shape)
+                off += size
 
     # delegation so DataParallel is transparent
     def parameters(self, include_sublayers=True):

@@ -18,7 +18,8 @@ from jax.sharding import Mesh
 
 from .collective import Group
 
-__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh"]
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
+           "build_hybrid_mesh"]
 
 
 class CommunicateTopology:
@@ -83,6 +84,34 @@ def build_mesh(*, dp=1, pp=1, sharding=1, sep=1, ep=1, mp=1, devices=None):
             f"mesh {shape} needs {int(np.prod(shape))} devices, have {devices.size}")
     dev_grid = devices.reshape(shape)
     return Mesh(dev_grid, ("dp", "pp", "sharding", "sep", "ep", "mp"))
+
+
+def build_hybrid_mesh(*, ici=None, dcn=None, devices=None):
+    """Two-tier ICI/DCN mesh (the reference's ProcessGroupHeter pattern,
+    ProcessGroupHeter.h:64, done the TPU way): per-axis degrees split into
+    an intra-slice (ICI) factor and a cross-slice (DCN) factor, laid out
+    with jax mesh_utils so DCN-factored axes change slowest — collectives
+    on ici-only axes never cross the data-center network.
+
+    ici/dcn: dicts over the canonical axes ("dp","pp","sharding","sep",
+    "ep","mp"), missing axes default to 1.  Example for 2 slices doing
+    data-parallel across DCN: build_hybrid_mesh(ici=dict(mp=4, dp=2),
+    dcn=dict(dp=2)).
+    """
+    from jax.experimental import mesh_utils
+
+    axes = ("dp", "pp", "sharding", "sep", "ep", "mp")
+    ici = {**{a: 1 for a in axes}, **(ici or {})}
+    dcn = {**{a: 1 for a in axes}, **(dcn or {})}
+    ici_shape = tuple(ici[a] for a in axes)
+    dcn_shape = tuple(dcn[a] for a in axes)
+    if all(d == 1 for d in dcn_shape):
+        total = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+        return build_mesh(**dict(zip(axes, total)), devices=devices)
+    dev_grid = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape,
+        devices=devices if devices is not None else jax.devices())
+    return Mesh(dev_grid, axes)
 
 
 class HybridCommunicateGroup:

@@ -56,9 +56,6 @@ def load_tcp_store_lib():
         lib.ts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_longlong,
                                ctypes.POINTER(ctypes.c_longlong)]
-        lib.ts_wait.restype = ctypes.c_long
-        lib.ts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                ctypes.c_char_p, ctypes.c_long]
         lib.ts_delete.restype = ctypes.c_int
         lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         _LIB = lib

@@ -119,14 +119,9 @@ struct Server {
           cv.notify_all();
           break;
         }
-        case 3: {  // WAIT (server blocks until present or shutdown)
-          std::unique_lock<std::mutex> g(mu);
-          cv.wait(g, [&] { return stopping || kv.count(key) > 0; });
-          if (stopping || kv.count(key) == 0) {
-            status = 1;
-          } else {
-            out = kv[key];
-          }
+        case 3: {  // reserved (was server-side WAIT; clients now poll —
+          // a blocking server wait pinned the client's request mutex)
+          status = 1;
           break;
         }
         case 4: {  // DELETE
@@ -143,6 +138,17 @@ struct Server {
       uint64_t olen = out.size();
       if (!send_all(fd, &status, 1) || !send_all(fd, &olen, 8)) break;
       if (olen && !send_all(fd, out.data(), olen)) break;
+    }
+    {
+      // forget the fd BEFORE closing: the OS recycles fd numbers, and
+      // stop() must never shutdown() an unrelated descriptor
+      std::lock_guard<std::mutex> g(fds_mu);
+      for (auto it = client_fds.begin(); it != client_fds.end(); ++it) {
+        if (*it == fd) {
+          client_fds.erase(it);
+          break;
+        }
+      }
     }
     ::close(fd);
   }
@@ -313,15 +319,6 @@ int ts_add(void* h, const char* key, long long delta,
   std::memcpy(&v, out.data(), sizeof(int64_t));
   *out_value = v;
   return 0;
-}
-
-long ts_wait(void* h, const char* key, char* buf, long cap) {
-  std::string out;
-  int st = static_cast<Client*>(h)->request(3, key, "", &out);
-  if (st != 0) return -2;
-  if (static_cast<long>(out.size()) > cap) return -3;
-  std::memcpy(buf, out.data(), out.size());
-  return static_cast<long>(out.size());
 }
 
 int ts_delete(void* h, const char* key) {

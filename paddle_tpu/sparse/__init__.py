@@ -134,13 +134,16 @@ def matmul(a, b):
 
 
 def add(a, b):
-    """sparse + sparse → sparse (same format)."""
-    if isinstance(a, SparseCsrTensor):
-        return add(a.to_sparse_coo(), b.to_sparse_coo()
-                   if isinstance(b, SparseCsrTensor) else b)
-    bb = b._bcoo if isinstance(b, SparseCooTensor) else b._bcoo()
-    summed = jsparse.bcoo_sum_duplicates(_coo_add(a._bcoo, bb))
-    return SparseCooTensor(summed)
+    """sparse + sparse → sparse (same format as ``a``)."""
+    if a.shape != b.shape:
+        raise ValueError(f"sparse add shape mismatch: {a.shape} vs "
+                         f"{b.shape}")
+    want_csr = isinstance(a, SparseCsrTensor)
+    aa = a.to_sparse_coo() if want_csr else a
+    bb = b.to_sparse_coo() if isinstance(b, SparseCsrTensor) else b
+    summed = jsparse.bcoo_sum_duplicates(_coo_add(aa._bcoo, bb._bcoo))
+    out = SparseCooTensor(summed)
+    return out.to_sparse_csr() if want_csr else out
 
 
 def _coo_add(x, y):

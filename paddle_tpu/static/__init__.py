@@ -42,7 +42,10 @@ class Executor:
                                  "program")
             fetch_vids.append(vid)
 
-        key = (id(program), len(program.ops), tuple(fetch_vids),
+        # version catches in-place mutation (appended ops, user-applied
+        # passes); the cached entry holds a strong ref to the source
+        # program so id() cannot be recycled while the entry lives
+        key = (id(program), program.version, tuple(fetch_vids),
                tuple(sorted(feed)), tuple(use_passes or ()))
         entry = self._cache.get(key)
         if entry is None:
@@ -53,9 +56,9 @@ class Executor:
             def fn(feed_arrays, param_arrays):
                 return prog.replay(feed_arrays, fetch_vids, param_arrays)
 
-            entry = (jax.jit(fn), prog)
+            entry = (jax.jit(fn), prog, program)
             self._cache[key] = entry
-        runner, prog = entry
+        runner, prog, _src = entry
         # params enter as jit INPUTS, so weight updates between runs are
         # visible (the reference's scope-variable semantics)
         out = runner(

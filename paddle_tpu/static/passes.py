@@ -57,6 +57,7 @@ class DeadCodeEliminationPass(Pass):
                 live.update(op.input_vids())
         removed = len(program.ops) - len(kept)
         program.ops = list(reversed(kept))
+        program.version += 1
         return removed
 
 
@@ -78,6 +79,7 @@ class AmpBf16Pass(Pass):
                 continue
             op.pure_fn = self._wrap(op.pure_fn)
             count += 1
+        program.version += 1
         return count
 
     @staticmethod

@@ -93,6 +93,9 @@ class Program:
         # strong refs to every tensor we keyed by id(): CPython reuses
         # addresses after GC, which would miswire lookup()
         self._keepalive: list = []
+        # bumped on every mutation (record / pass application) so the
+        # Executor's compile cache can detect in-place rewrites
+        self.version = 0
 
     # ---------------------------------------------------------- building
     def add_feed(self, name, shape, dtype):
@@ -126,6 +129,7 @@ class Program:
             self._keepalive.append(t)
             out_vids.append(vid)
         self.ops.append(OpDesc(op_name, pure_fn, treedef, enc, out_vids))
+        self.version += 1
 
     # ----------------------------------------------------------- replay
     def param_refs(self):

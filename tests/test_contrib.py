@@ -1,0 +1,160 @@
+"""contrib tests: quantization (QAT/PTQ) + ASP sparsity + DDP bucketing +
+hybrid mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestFakeQuant:
+    def test_quantize_dequantize(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.contrib.quant import fake_quant
+
+        x = jnp.asarray(np.linspace(-1, 1, 9, dtype=np.float32))
+        out = np.asarray(fake_quant(x, jnp.float32(1.0), 8))
+        # values snap to the 127-level grid, endpoints exact
+        np.testing.assert_allclose(out[[0, -1]], [-1.0, 1.0], atol=1e-6)
+        err = np.abs(out - np.asarray(x)).max()
+        assert 0 < err < 1.0 / 127
+
+    def test_straight_through_gradient(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.contrib.quant import fake_quant
+
+        g = jax.grad(lambda x: fake_quant(x, jnp.float32(1.0), 8).sum())(
+            jnp.asarray([0.5, 2.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(g), [1.0, 0.0])  # STE clips
+
+
+class TestQAT:
+    def _net(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def test_quantize_swaps_linears(self):
+        from paddle_tpu.contrib import QAT, QuantizedLinear
+
+        net = self._net()
+        QAT().quantize(net)
+        kinds = [type(l).__name__ for l in net]
+        assert kinds.count("QuantizedLinear") == 2
+
+    def test_qat_forward_close_and_trainable(self):
+        from paddle_tpu.contrib import QAT, quant_scales
+        from paddle_tpu.contrib.quant import quant_scales
+
+        net = self._net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        ref = np.asarray(net(x).data)
+        QAT().quantize(net)
+        out = net(x)
+        np.testing.assert_allclose(np.asarray(out.data), ref, atol=0.1)
+        # gradients flow to the shared fp weights
+        loss = (out ** 2).mean()
+        loss.backward()
+        inner = net[0].inner
+        assert inner.weight.grad is not None
+        assert float(np.abs(np.asarray(inner.weight.grad.data)).sum()) > 0
+        scales = quant_scales(net)
+        assert len(scales) == 2 and all(
+            s["weight"] > 0 for s in scales.values())
+
+    def test_ptq_calibrate_and_convert(self):
+        from paddle_tpu.contrib import PTQ
+
+        net = self._net()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(16, 8).astype(np.float32))
+        ref = np.asarray(net(x).data)
+        ptq = PTQ()
+        ptq.quantize(net)
+        net(x)                      # calibration pass
+        assert len(ptq.scales()) == 2
+        ptq.convert(net)
+        out = np.asarray(net(x).data)
+        np.testing.assert_allclose(out, ref, atol=0.15)
+
+
+class TestASP:
+    def test_create_mask_2_4(self):
+        from paddle_tpu.contrib import check_mask, create_mask
+
+        w = np.random.RandomState(0).randn(16, 16).astype(np.float32)
+        mask = create_mask(w)
+        assert mask.sum() == w.size // 2          # exactly 2 of 4 kept
+        assert check_mask(w * mask)
+        assert not check_mask(w)                  # dense fails the check
+        # the kept entries are the 2 largest |w| of each group
+        flat_w = np.abs(w.reshape(-1, 4))
+        flat_m = mask.reshape(-1, 4)
+        for i in range(flat_w.shape[0]):
+            kept = set(np.nonzero(flat_m[i])[0])
+            top2 = set(np.argsort(-flat_w[i])[:2])
+            assert kept == top2
+
+    def test_prune_and_decorate_keeps_sparsity(self):
+        from paddle_tpu.contrib import check_mask, decorate, prune_model
+
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 4))
+        prune_model(net)
+        assert check_mask(net[0].weight)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        decorate(opt, net)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(8, 16).astype(np.float32))
+        for _ in range(3):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # masks survived the optimizer updates
+        assert check_mask(net[0].weight)
+        assert check_mask(net[2].weight)
+
+
+class TestBucketsAndHybridMesh:
+    def test_grad_buckets_fuse(self):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8),
+                            nn.Linear(8, 2))
+        dp = dist.DataParallel(net, comm_buffer_size=25)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        (dp(x) ** 2).mean().backward()
+        buckets = dp._grad_buckets()
+        # all fp32 grads fit one 25MB bucket: ONE fused allreduce
+        assert len(buckets) == 1
+        assert len(buckets[0]) == 6
+        g_before = np.asarray(net[0].weight.grad.data).copy()
+        dp.apply_collective_grads()   # 1 process: identity
+        np.testing.assert_allclose(np.asarray(net[0].weight.grad.data),
+                                   g_before, atol=0)
+
+    def test_tiny_buffer_splits_buckets(self):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 2))
+        dp = dist.DataParallel(net, comm_buffer_size=1e-5)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        (dp(x) ** 2).mean().backward()
+        assert len(dp._grad_buckets()) > 1
+
+    def test_hybrid_mesh_single_slice(self):
+        import jax
+
+        from paddle_tpu.distributed.topology import build_hybrid_mesh
+
+        mesh = build_hybrid_mesh(ici=dict(dp=2, mp=4))
+        assert mesh.axis_names == ("dp", "pp", "sharding", "sep", "ep",
+                                   "mp")
+        assert mesh.devices.shape == (2, 1, 1, 1, 1, 4)
