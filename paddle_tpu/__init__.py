@@ -62,6 +62,7 @@ from . import utils  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import contrib  # noqa: F401
+from . import device  # noqa: F401
 from . import vision  # noqa: F401
 from .framework_io import load, save  # noqa: F401
 

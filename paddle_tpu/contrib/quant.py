@@ -83,7 +83,11 @@ class _MovingAbsMax:
 
 class QuantizedLinear(Layer):
     """Linear with fake-quantized weights + activations (QAT module).
-    Wraps an existing Linear, sharing its parameters."""
+    Wraps an existing Linear, sharing its parameters.  The weight scale
+    initializes from the (concrete) wrapped weight; the activation scale
+    needs at least one EAGER batch (scales cannot be observed through jit
+    tracers) — running jitted before that raises instead of silently
+    quantizing with a wrong range."""
 
     def __init__(self, linear, weight_bits=8, activation_bits=8,
                  momentum=0.9):
@@ -93,17 +97,25 @@ class QuantizedLinear(Layer):
         self.activation_bits = activation_bits
         self._w_scale = _MovingAbsMax(momentum)
         self._a_scale = _MovingAbsMax(momentum)
+        self._w_scale.update(linear.weight.data)   # weights are concrete
+        self.freeze_scales = False   # set by PTQ.convert
 
     def forward(self, x):
         from .. import ops
 
         xv = x.data if isinstance(x, Tensor) else x
         w = self.inner.weight
-        if not isinstance(xv, jax.core.Tracer):
+        if not self.freeze_scales and not isinstance(xv, jax.core.Tracer):
             self._a_scale.update(xv)
             self._w_scale.update(w.data)
-        a_s = jnp.asarray(self._a_scale.scale or 1.0, jnp.float32)
-        w_s = jnp.asarray(self._w_scale.scale or 1.0, jnp.float32)
+        if self._a_scale.scale is None:
+            raise RuntimeError(
+                "QuantizedLinear has no activation scale yet: run at "
+                "least one eager (non-jit) batch to calibrate, or set "
+                "._a_scale.scale explicitly — tracer inputs cannot be "
+                "observed")
+        a_s = jnp.asarray(self._a_scale.scale, jnp.float32)
+        w_s = jnp.asarray(self._w_scale.scale, jnp.float32)
         xq = _fake_quant_op(x if isinstance(x, Tensor) else Tensor(xv),
                             Tensor(a_s), bits=self.activation_bits)
         wq = _fake_quant_op(w, Tensor(w_s), bits=self.weight_bits)
@@ -132,8 +144,8 @@ class QAT:
         def swap(layer):
             for name, sub in list(layer._sub_layers.items()):
                 if isinstance(sub, Linear):
-                    layer._sub_layers[name] = QuantizedLinear(
-                        sub, self.weight_bits, self.activation_bits)
+                    _replace_sublayer(layer, name, QuantizedLinear(
+                        sub, self.weight_bits, self.activation_bits))
                 else:
                     swap(sub)
 
@@ -182,13 +194,22 @@ class PTQ:
                     q = QuantizedLinear(sub, self.bits, self.bits)
                     if full in self._observers:
                         q._a_scale.scale = self._observers[full].scale
-                    q._w_scale.update(sub.weight.data)
-                    layer._sub_layers[name] = q
+                    q.freeze_scales = True   # calibrated: no drift
+                    _replace_sublayer(layer, name, q)
                 else:
                     swap(sub, full)
 
         swap(model)
         return model
+
+
+def _replace_sublayer(layer, name, new):
+    """Swap a child in BOTH registries: _sub_layers (named_sublayers /
+    Sequential indexing) and the instance __dict__ (attribute access à la
+    ``self.fc``) — updating only one leaves a stale alias."""
+    layer._sub_layers[name] = new
+    if layer.__dict__.get(name) is not None:
+        layer.__dict__[name] = new
 
 
 def quant_scales(model):

@@ -1,0 +1,78 @@
+"""Device management (parity: python/paddle/device/ set_device/
+get_device + the pluggable-device C API, phi/backends/device_ext.h:48
+``C_DeviceInterface`` / device_manager.h:114 ``DeviceManager``).
+
+TPU-native pluggable devices: the reference loads vendor runtime plugins
+implementing C_DeviceInterface; jax's equivalent is a PJRT plugin (.so
+implementing the PJRT C API).  ``register_custom_device`` wires a plugin
+into jax's discovery — after that, Places/Tensors/set_device address it
+by name exactly like 'cpu'/'tpu'.  This is the sanctioned new-hardware
+path; no framework code changes needed per backend (the property the
+reference's CustomDevice exists to provide).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..core.place import (CPUPlace, CustomPlace, Place, TPUPlace,
+                          device_count, get_all_devices, get_device,
+                          set_device)
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "Place", "CPUPlace", "TPUPlace", "CustomPlace",
+           "register_custom_device", "get_all_custom_device_type",
+           "is_custom_device_available"]
+
+_registered: dict[str, str] = {}
+
+
+def _backend_initialized():
+    from jax._src import xla_bridge
+
+    return bool(getattr(xla_bridge, "_backends", {}))
+
+
+def register_custom_device(device_type: str, library_path: str):
+    """Register a PJRT plugin as a named custom device.
+
+    Must run BEFORE any jax backend use (like the reference, which loads
+    plugin .so files at InitDevices time).  The plugin becomes visible to
+    jax device discovery; ``set_device(device_type)`` then selects it.
+    """
+    if _backend_initialized():
+        raise RuntimeError(
+            "register_custom_device must be called before the first jax "
+            "backend use (a plugin cannot be added to an initialized "
+            "runtime) — register at program start")
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(
+            f"PJRT plugin for {device_type!r} not found: {library_path}")
+    try:
+        from jax._src.lib import xla_client
+
+        xla_client.load_pjrt_plugin_dynamically(device_type, library_path)
+        cfg = os.environ.get("PJRT_NAMES_AND_LIBRARY_PATHS", "")
+        entry = f"{device_type}:{library_path}"
+        os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = \
+            f"{cfg},{entry}" if cfg else entry
+    except Exception as e:  # plugin load is backend-specific
+        raise RuntimeError(
+            f"failed to load PJRT plugin {library_path!r} for "
+            f"{device_type!r}: {e}") from e
+    _registered[device_type] = library_path
+    return CustomPlace(device_type, 0)
+
+
+def get_all_custom_device_type():
+    """Registered custom device names (reference:
+    device/__init__.py get_all_custom_device_type)."""
+    return sorted(_registered)
+
+
+def is_custom_device_available(device_type: str) -> bool:
+    try:
+        return len(jax.devices(device_type)) > 0
+    except Exception:
+        return False

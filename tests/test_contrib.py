@@ -158,3 +158,55 @@ class TestBucketsAndHybridMesh:
         assert mesh.axis_names == ("dp", "pp", "sharding", "sep", "ep",
                                    "mp")
         assert mesh.devices.shape == (2, 1, 1, 1, 1, 4)
+
+
+class TestQuantEdgeCases:
+    def test_attribute_style_model_quantized(self):
+        """QAT must swap the layer in BOTH registries (review repro)."""
+        from paddle_tpu.contrib import QAT, QuantizedLinear
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                paddle.seed(0)
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        ref = np.asarray(net(x).data)
+        QAT().quantize(net)
+        assert isinstance(net.fc, QuantizedLinear)   # attribute swapped
+        out = np.asarray(net(x).data)
+        assert np.abs(out - ref).max() > 0           # really quantized
+
+    def test_converted_scales_frozen(self):
+        from paddle_tpu.contrib import PTQ
+
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(8, 4))
+        ptq = PTQ()
+        ptq.quantize(net)
+        big = paddle.to_tensor(np.full((4, 8), 23.0, np.float32))
+        net(big)                      # calibration sees the outlier
+        ptq.convert(net)
+        s0 = net[0]._a_scale.scale
+        small = paddle.to_tensor(np.full((4, 8), 0.1, np.float32))
+        for _ in range(5):
+            net(small)
+        assert net[0]._a_scale.scale == s0   # no drift after convert
+
+    def test_uncalibrated_raises(self):
+        from paddle_tpu.contrib.quant import QuantizedLinear
+
+        paddle.seed(2)
+        q = QuantizedLinear(nn.Linear(4, 2))
+        import jax
+
+        with pytest.raises(RuntimeError, match="calibrate"):
+            jax.eval_shape(
+                lambda a: q(paddle.Tensor(a)).data,
+                jax.ShapeDtypeStruct((2, 4), np.float32))

@@ -1,0 +1,48 @@
+"""Device API tests (N3 pluggable-device facade)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestDeviceAPI:
+    def test_set_get_device(self):
+        prev = paddle.device.get_device()
+        paddle.device.set_device("cpu")
+        assert paddle.device.get_device().startswith("cpu")
+        paddle.device.set_device(prev)
+
+    def test_register_after_init_raises(self):
+        import jax
+
+        jax.devices()   # force backend init
+        with pytest.raises(RuntimeError, match="before"):
+            paddle.device.register_custom_device("mydev", "/tmp/x.so")
+
+    def test_register_missing_plugin_raises(self):
+        """Fresh process (backend not initialized): missing .so must be a
+        clear FileNotFoundError, not a lazy jax failure."""
+        script = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+import paddle_tpu as paddle
+try:
+    paddle.device.register_custom_device("npu", "/nonexistent/libnpu.so")
+    print("NO_RAISE")
+except FileNotFoundError as e:
+    print("RAISED_OK")
+"""
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("XLA_", "JAX_"))}
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert "RAISED_OK" in proc.stdout, (proc.stdout, proc.stderr)
+
+    def test_custom_device_queries(self):
+        assert paddle.device.get_all_custom_device_type() == []
+        assert not paddle.device.is_custom_device_available("not_a_device")
