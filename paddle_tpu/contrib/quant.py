@@ -194,7 +194,10 @@ class PTQ:
                     q = QuantizedLinear(sub, self.bits, self.bits)
                     if full in self._observers:
                         q._a_scale.scale = self._observers[full].scale
-                    q.freeze_scales = True   # calibrated: no drift
+                        q.freeze_scales = True   # calibrated: no drift
+                    # a Linear never exercised during calibration keeps a
+                    # live (unfrozen) observer so its first eager batch
+                    # can still set a scale instead of erroring forever
                     _replace_sublayer(layer, name, q)
                 else:
                     swap(sub, full)

@@ -37,6 +37,13 @@ class ElasticManager:
     * match() — membership equals the expected np
     * watch(timeout) — blocks until membership changes from matching to
       broken (node lost / joined), returns the event
+
+    Liveness is clock-skew-free: heartbeats are a monotonically
+    increasing per-node counter (store.add), and a peer counts as alive
+    while its counter keeps ADVANCING within node_timeout of the
+    *reader's* monotonic clock — wall-clock timestamps never cross
+    hosts (the reference gets the same property from etcd server-side
+    TTL leases).
     """
 
     def __init__(self, store: TCPStore = None, job_id="default", np=1,
@@ -59,17 +66,25 @@ class ElasticManager:
         self.node_timeout = node_timeout
         self._stop = threading.Event()
         self._thread = None
+        # host -> (last counter value, reader-side monotonic time it advanced)
+        self._seen = {}
 
     # ---------------------------------------------------------- membership
     def _key(self):
         return f"elastic/{self.job}/{self.host}"
 
     def register(self):
-        self.store.set(self._key(), str(time.time()))
+        self.store.add(self._key(), 1)
 
         def beat():
             while not self._stop.wait(self.heartbeat_interval):
-                self.store.set(self._key(), str(time.time()))
+                try:
+                    self.store.add(self._key(), 1)
+                except Exception:
+                    # transient store error: keep beating — a single
+                    # blip must not silence a healthy node for good (the
+                    # peer-side timeout handles truly-dead stores)
+                    continue
 
         self._thread = threading.Thread(target=beat, daemon=True)
         self._thread.start()
@@ -85,11 +100,19 @@ class ElasticManager:
     # store is scanless by design, so peers are probed by name
     def probe(self, host):
         try:
-            raw = self.store.get(f"elastic/{self.job}/{host}",
-                                 blocking=False)
-        except KeyError:
+            counter = self.store.add(f"elastic/{self.job}/{host}", 0)
+        except TypeError:
+            return False        # key holds junk — not a registered node
+        # store I/O errors (RuntimeError) propagate: a network blip must
+        # not read as "every node died" and trigger a spurious relaunch
+        if counter <= 0:        # never registered (add(0) creates at 0)
             return False
-        return (time.time() - float(raw.decode())) < self.node_timeout
+        now = time.monotonic()
+        prev = self._seen.get(host)
+        if prev is None or counter != prev[0]:
+            self._seen[host] = (counter, now)
+            return True
+        return (now - prev[1]) < self.node_timeout
 
     def match(self, hosts):
         """True when every expected host is alive and none extra expected."""
@@ -97,11 +120,26 @@ class ElasticManager:
         return len(alive) == self.np
 
     def wait_for_np(self, hosts, timeout=30.0):
-        deadline = time.time() + timeout
+        """Blocks until membership matches np — and HOLDS for a full
+        node_timeout.  The hold defeats the first-sighting grace window:
+        a freshly-constructed manager (post-relaunch) seeing a crashed
+        peer's stale counter counts it alive only until the window
+        expires, so a match built on corpses breaks before we return.
+        The deadline is therefore extended to fit at least one full hold
+        window (timeout < node_timeout could otherwise never succeed)."""
+        deadline = time.time() + max(timeout,
+                                     self.node_timeout + 2 * self.heartbeat_interval)
+        held_since = None
         while time.time() < deadline:
             if self.match(hosts):
-                return True
-            time.sleep(self.heartbeat_interval)
+                now = time.monotonic()
+                if held_since is None:
+                    held_since = now
+                if now - held_since >= self.node_timeout:
+                    return True
+            else:
+                held_since = None
+            time.sleep(min(self.heartbeat_interval, 0.1))
         return False
 
     def watch(self, hosts, timeout=60.0):

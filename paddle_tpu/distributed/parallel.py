@@ -31,6 +31,33 @@ class DataParallel(Layer):
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    def _resolve_unused(self):
+        """The reference Reducer walks the autograd graph to find params
+        the loss never reached (imperative/reducer.cc:126
+        find_unused_parameters).  Our vjp tape already encodes
+        reachability: a trainable param the backward pass never touched
+        is left with grad=None.  With the flag set we zero-fill those so
+        every rank all-reduces an identical bucket set; without it a
+        missing grad is a hard error (ranks would otherwise build
+        different buckets and desync the collective)."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        unused = [p for p in self._layers.parameters()
+                  if not p.stop_gradient and p.grad is None]
+        if not unused:
+            return
+        if not self.find_unused_parameters:
+            raise RuntimeError(
+                f"DataParallel: {len(unused)} trainable parameter(s) "
+                f"received no gradient this step; ranks would build "
+                f"mismatched allreduce buckets. Pass "
+                f"find_unused_parameters=True (zero-fills them) or make "
+                f"the loss depend on every trainable parameter.")
+        for p in unused:
+            p.grad = Tensor(jnp.zeros_like(p.data))
+
     def _grad_buckets(self):
         """Group grads into ~comm_buffer_size MB same-dtype buckets — the
         Reducer's bucketing (imperative/reducer.cc:126): one fused
@@ -61,6 +88,7 @@ class DataParallel(Layer):
         from ..core.tensor import Tensor
 
         n = self.group.nranks if self.group else jax.process_count()
+        self._resolve_unused()
         for bucket in self._grad_buckets():
             flat = jnp.concatenate(
                 [p.grad.data.reshape(-1) for p in bucket])

@@ -1,0 +1,218 @@
+"""Parameter-server (sparse/CTR) stack — minimal TPU-native take.
+
+Reference parity: paddle/fluid/distributed/ps/ (45k LoC) — PSClient
+(ps/service/ps_client.h:62), PSServer (ps/service/server.h:61), sharded
+Table (ps/table/table.h:65) over brpc, used for CTR models whose sparse
+embedding tables don't fit a chip.
+
+Design decision (SURVEY §7.9): the dense side of PS training is covered
+by the collective engine; what remains essential is the *sparse* half —
+giant embedding tables living on host servers, trainers pulling rows by
+id and pushing gradients asynchronously (hogwild).  We implement exactly
+that over the native TCPStore:
+
+* row storage    : one store key per (table, row-id), f32[dim]
+* row creation   : exactly ONE path — SETNX of the deterministic
+                   (hash-seeded) init row; concurrent first-touchers all
+                   attempt identical bytes and the store keeps the first
+* pull_sparse    : GET, with SETNX init on miss
+* push_sparse    : FADD (server-side atomic accumulate under the store
+                   mutex — the same hogwild property the reference gets
+                   from applying updates inside the brpc handler); FADD
+                   never creates rows, so a push can't race an
+                   initializing pull into a lost update
+* async SGD      : push(-lr * grad) IS the optimizer; no server code
+                   needed beyond the accumulate primitive
+* sharding       : N servers; rows map to a server by hash(id) % N,
+                   mirroring the reference's table sharding
+
+The TPU never sees the full table: pulled rows are gathered host-side
+into a dense [batch, dim] array and shipped once per step — embedding
+lookup stays off-chip, the dense tower stays on-chip.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..store import TCPStore
+
+__all__ = ["PSServer", "PSClient", "SparseTable", "SparseEmbedding"]
+
+
+class PSServer:
+    """One table-shard server == one native TCPStore master.
+
+    Reference: BrpcPsServer (ps/service/brpc_ps_server.cc) — ours is the
+    store server; the "service handlers" are the store op codes.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._store = TCPStore(host=host, port=port, is_master=True)
+        self.host = host
+        self.port = self._store.port
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def stop(self):
+        self._store._close_server()
+
+
+class PSClient:
+    """Connects to every server shard; routes rows by hash.
+
+    Reference: PSClient (ps/service/ps_client.h:62) — pull_sparse /
+    push_sparse are the two RPCs that matter.
+    """
+
+    def __init__(self, endpoints, timeout=30.0):
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        self._stores = []
+        for ep in endpoints:
+            h, p = ep.rsplit(":", 1)
+            self._stores.append(TCPStore(host=h, port=int(p),
+                                         timeout=timeout))
+
+    def _shard_index(self, row_id) -> int:
+        return hash(int(row_id)) % len(self._stores)
+
+    @staticmethod
+    def _key(table, row_id):
+        return f"ps/{table}/{int(row_id)}"
+
+    @staticmethod
+    def _init_row(rid, dim, init_std, seed):
+        rng = np.random.RandomState(
+            (seed * 1_000_003 + int(rid)) % (2**31 - 1))
+        return (rng.standard_normal(dim) * init_std).astype(np.float32)
+
+    def _ensure_row(self, store, key, rid, dim, init_std, seed):
+        """Create the row via SETNX if absent; whoever wins, the stored
+        row afterwards is init + any concurrently-pushed deltas."""
+        store.set_if_absent(
+            key, self._init_row(rid, dim, init_std, seed).tobytes())
+
+    def _by_shard(self, ids):
+        """Group positions by owning server: [(store, [positions])]."""
+        groups = {}
+        for pos, rid in enumerate(ids):
+            groups.setdefault(self._shard_index(rid), []).append(pos)
+        return [(self._stores[s], p) for s, p in groups.items()]
+
+    @staticmethod
+    def _check_dim(raw, dim, table, rid):
+        if len(raw) != dim * 4:
+            raise ValueError(
+                f"SparseTable {table!r} row {rid}: stored dim "
+                f"{len(raw) // 4} != requested dim {dim} — the table "
+                f"was created with a different embedding size")
+        return np.frombuffer(raw, dtype=np.float32)
+
+    def pull_sparse(self, table, ids, dim, init_std=0.01, seed=0):
+        """Fetch rows [len(ids), dim] — ONE batched round trip per
+        server shard; deterministic init-on-first-touch."""
+        out = np.empty((len(ids), dim), dtype=np.float32)
+        for store, positions in self._by_shard(ids):
+            keys = [self._key(table, ids[p]) for p in positions]
+            values = store.mget(keys, value_size_hint=dim * 4)
+            misses = [i for i, v in enumerate(values) if v is None]
+            if misses:
+                for i in misses:
+                    self._ensure_row(store, keys[i], ids[positions[i]],
+                                     dim, init_std, seed)
+                refetched = store.mget([keys[i] for i in misses],
+                                       value_size_hint=dim * 4)
+                for i, v in zip(misses, refetched):
+                    values[i] = v
+            for p, v in zip(positions, values):
+                out[p] = self._check_dim(v, dim, table, ids[p])
+        return out
+
+    def push_sparse(self, table, ids, deltas, init_std=0.01, seed=0):
+        """Atomically accumulate deltas into rows — ONE batched round
+        trip per server shard.  Async SGD = caller passes -lr * grad.
+        Duplicate ids within one push are applied per-occurrence
+        (accumulate is associative)."""
+        if not len(ids):
+            return
+        deltas = np.asarray(deltas, dtype=np.float32)
+        deltas = deltas.reshape(len(ids), -1)
+        for store, positions in self._by_shard(ids):
+            keys = [self._key(table, ids[p]) for p in positions]
+            rows = deltas[positions]
+            status = store.mfadd(keys, rows)
+            for i, st in enumerate(status):
+                if st == 1:   # first touch by a push: init, then retry
+                    self._ensure_row(store, keys[i], ids[positions[i]],
+                                     rows.shape[1], init_std, seed)
+                    store.fadd(keys[i], rows[i])
+                elif st != 0:
+                    raise ValueError(
+                        f"SparseTable {table!r} row {ids[positions[i]]}: "
+                        f"push dim {rows.shape[1]} does not match the "
+                        f"stored row")
+
+    def barrier(self, name="ps_barrier", world_size=1, timeout=None):
+        s = self._stores[0]
+        prev = s.world_size
+        s.world_size = world_size
+        try:
+            s.barrier(name=name, timeout=timeout)
+        finally:
+            s.world_size = prev
+
+
+class SparseTable:
+    """A named table bound to a client — the Table (table.h:65) facade."""
+
+    def __init__(self, client: PSClient, name: str, dim: int,
+                 init_std=0.01, seed=0):
+        self.client = client
+        self.name = name
+        self.dim = dim
+        self.init_std = init_std
+        self.seed = seed
+
+    def pull(self, ids):
+        return self.client.pull_sparse(self.name, ids, self.dim,
+                                       self.init_std, self.seed)
+
+    def push(self, ids, deltas):
+        self.client.push_sparse(self.name, ids, deltas,
+                                self.init_std, self.seed)
+
+
+class SparseEmbedding:
+    """Host-side embedding over a SparseTable for CTR-style models.
+
+    forward(ids) pulls rows (host) and returns a device array; after the
+    dense backward produces d_embedding, call ``apply_grads(grad)`` (ids
+    default to the last forward's) to push the async-SGD update.  This is the
+    `operators/pscore/send_op`-style boundary: sparse traffic rides DCN
+    to host servers, dense compute stays on the chip.
+    """
+
+    def __init__(self, table: SparseTable, lr=0.01):
+        self.table = table
+        self.lr = lr
+        self._last_ids = None
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+
+        ids = np.asarray(ids).reshape(-1)
+        self._last_ids = ids
+        rows = self.table.pull(ids)
+        return jnp.asarray(rows)
+
+    __call__ = forward
+
+    def apply_grads(self, grad, ids=None, lr=None):
+        ids = self._last_ids if ids is None else np.asarray(ids).reshape(-1)
+        if ids is None:
+            raise RuntimeError("SparseEmbedding.apply_grads: no ids "
+                               "recorded — run forward() first or pass ids=")
+        g = np.asarray(grad, dtype=np.float32).reshape(len(ids), -1)
+        self.table.push(ids, -(self.lr if lr is None else lr) * g)

@@ -59,6 +59,17 @@ class TCPStore:
             n = self._lib.ts_get(self._client, key.encode(), buf, len(buf))
             if n >= 0:
                 return buf.raw[:n]
+            if n <= -16:
+                # value larger than the client buffer: the server told us
+                # the exact length (-(len)-16) — retry once at that size
+                # (capped at 1 GiB so a corrupt length can't OOM us)
+                need = -n - 16
+                if need > (1 << 30):
+                    raise RuntimeError(
+                        f"TCPStore.get({key!r}): value of {need} bytes "
+                        f"exceeds the 1 GiB client cap")
+                buf = ctypes.create_string_buffer(need)
+                continue
             if n != -1:
                 raise RuntimeError(f"TCPStore.get({key!r}) failed rc={n}")
             if not blocking:
@@ -75,6 +86,9 @@ class TCPStore:
         out = ctypes.c_longlong(0)
         rc = self._lib.ts_add(self._client, key.encode(), int(delta),
                               ctypes.byref(out))
+        if rc == 1:
+            raise TypeError(
+                f"TCPStore.add({key!r}): key holds a non-counter value")
         if rc != 0:
             raise RuntimeError(f"TCPStore.add({key!r}) failed rc={rc}")
         return int(out.value)
@@ -85,6 +99,100 @@ class TCPStore:
 
     def delete_key(self, key: str):
         self._lib.ts_delete(self._client, key.encode())
+
+    def fadd(self, key: str, delta):
+        """Atomic f32-vector accumulate into an EXISTING row; returns
+        the post-add row as a numpy array.  The sparse parameter-server
+        push primitive.  Raises KeyError if the row was never created
+        (creation is set_if_absent — the single creation path)."""
+        import ctypes
+
+        import numpy as np
+
+        arr = np.ascontiguousarray(delta, dtype=np.float32).ravel()
+        out = np.empty_like(arr)
+        rc = self._lib.ts_fadd(
+            self._client, key.encode(),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            arr.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc == 1:
+            raise KeyError(key)
+        if rc != 0:
+            raise RuntimeError(
+                f"TCPStore.fadd({key!r}) failed rc={rc} "
+                f"(3 = row dimension mismatch)")
+        return out
+
+    def _batched(self, fn_name, payload, cap_guess):
+        import ctypes
+
+        fn = getattr(self._lib, fn_name)
+        buf = ctypes.create_string_buffer(cap_guess)
+        while True:
+            n = fn(self._client, payload, len(payload), buf, len(buf))
+            if n >= 0:
+                return buf.raw[:n]
+            if n <= -16:
+                buf = ctypes.create_string_buffer(-n - 16)
+                continue
+            raise RuntimeError(f"TCPStore.{fn_name} failed rc={n}")
+
+    def mget(self, keys, value_size_hint=64):
+        """Batched get: ONE round trip for all keys.  Returns a list of
+        bytes-or-None (None = missing).  Pass value_size_hint (expected
+        bytes per value) so the first response buffer fits — a short
+        buffer costs a full server-side re-execution."""
+        import struct
+
+        if not keys:
+            return []
+        payload = struct.pack("<I", len(keys)) + b"".join(
+            struct.pack("<I", len(k.encode())) + k.encode() for k in keys)
+        raw = self._batched("ts_mget", payload,
+                            max(1 << 16, (8 + value_size_hint) * len(keys)))
+        out, off = [], 0
+        for _ in keys:
+            (vlen,) = struct.unpack_from("<Q", raw, off)
+            off += 8
+            if vlen == 0xFFFFFFFFFFFFFFFF:
+                out.append(None)
+            else:
+                out.append(raw[off:off + vlen])
+                off += vlen
+        return out
+
+    def mfadd(self, keys, rows):
+        """Batched atomic f32 accumulate (rows: [n, dim] f32, applied to
+        EXISTING rows only).  Returns per-row status list: 0 ok,
+        1 missing (caller creates via set_if_absent and retries),
+        3 dimension mismatch."""
+        import struct
+
+        import numpy as np
+
+        if not keys:
+            return []
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        rows = rows.reshape(len(keys), -1)
+        rowbytes = rows.shape[1] * 4
+        payload = struct.pack("<II", len(keys), rowbytes) + b"".join(
+            struct.pack("<I", len(k.encode())) + k.encode() + r.tobytes()
+            for k, r in zip(keys, rows))
+        raw = self._batched("ts_mfadd", payload, max(1024, len(keys)))
+        return list(raw)
+
+    def set_if_absent(self, key: str, value) -> bool:
+        """Atomically create key=value; returns False (no write) if the
+        key already exists.  The only operation that creates PS rows."""
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.ts_setnx(self._client, key.encode(), value,
+                                len(value))
+        if rc == 0:
+            return True
+        if rc == 1:
+            return False
+        raise RuntimeError(f"TCPStore.set_if_absent({key!r}) rc={rc}")
 
     # -------------------------------------------------------------- barrier
     def barrier(self, name="_barrier", timeout=None):

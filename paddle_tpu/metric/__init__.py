@@ -73,10 +73,13 @@ class Accuracy(Metric):
         return correct
 
     def update(self, correct):
+        # flatten to [N, k] so rank>2 inputs (e.g. [B, S, V] sequence
+        # logits) count B*S samples, not B (reference reshapes likewise)
         c = _np(correct)
+        c = c.reshape(-1, c.shape[-1]) if c.ndim > 1 else c.reshape(-1, 1)
         n = c.shape[0]
         for i, k in enumerate(self.topk):
-            self._correct[i] += float(c[..., :k].sum())
+            self._correct[i] += float(c[:, :k].sum())
         self._count += n
         return self.accumulate()
 

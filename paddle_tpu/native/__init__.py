@@ -58,5 +58,17 @@ def load_tcp_store_lib():
                                ctypes.POINTER(ctypes.c_longlong)]
         lib.ts_delete.restype = ctypes.c_int
         lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_fadd.restype = ctypes.c_int
+        lib.ts_fadd.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_float),
+                                ctypes.c_long,
+                                ctypes.POINTER(ctypes.c_float)]
+        lib.ts_setnx.restype = ctypes.c_int
+        lib.ts_setnx.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_long]
+        for fn in (lib.ts_mget, lib.ts_mfadd):
+            fn.restype = ctypes.c_long
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
         _LIB = lib
         return lib

@@ -9,7 +9,20 @@
 //   request : u8 op | u32 klen | key | u64 vlen | value
 //   response: u8 status | u64 vlen | value
 // ops: 0=SET 1=GET 2=ADD(value=i64 LE) 3=WAIT 4=DELETE 5=PING
+//      6=FADD(value=f32[] LE — elementwise accumulate into an EXISTING
+//        row; the atomic push-gradient primitive the parameter-server
+//        sparse tables ride on: reference ps/table/table.h:65 applies
+//        updates inside the brpc handler for the same hogwild property.
+//        Never creates rows — creation has exactly one path, SETNX, so
+//        a push can't race an initializing pull into a lost update)
+//      7=SETNX(create-if-absent; status 1 if the key already exists)
+//      8=MGET (value = u32 count, count×(u32 klen|key); response =
+//        count×(u64 vlen|value), vlen=u64max marking a missing key —
+//        one round trip for a whole sparse-table shard pull)
+//      9=MFADD(value = u32 count, u32 rowbytes, count×(u32 klen|key|
+//        row); response = count×u8 per-row status — the batched push)
 // status: 0=ok 1=missing (GET/WAIT timeout handled client-side by retry)
+//         3=shape mismatch (FADD against a row of a different length)
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -132,6 +145,104 @@ struct Server {
         case 5:  // PING
           out = "pong";
           break;
+        case 6: {  // FADD: f32 vector accumulate under the store mutex
+          if (val.size() % sizeof(float) != 0) {
+            status = 3;
+            break;
+          }
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          if (it == kv.end()) {
+            status = 1;  // no row: caller must SETNX-initialize first
+            break;
+          }
+          if (it->second.size() != val.size()) {
+            status = 3;  // dimension mismatch with the stored row
+            break;
+          }
+          float* row = reinterpret_cast<float*>(&it->second[0]);
+          const float* d = reinterpret_cast<const float*>(val.data());
+          for (size_t i = 0; i < val.size() / sizeof(float); ++i)
+            row[i] += d[i];
+          out = it->second;
+          cv.notify_all();
+          break;
+        }
+        case 7: {  // SETNX: the single row-creation path
+          std::lock_guard<std::mutex> g(mu);
+          if (kv.find(key) != kv.end()) {
+            status = 1;  // lost the creation race — existing row wins
+            break;
+          }
+          kv[key] = val;
+          cv.notify_all();
+          break;
+        }
+        case 8: {  // MGET: batched lookup, one lock + one round trip
+          const char* p = val.data();
+          const char* end = p + val.size();
+          uint32_t count = 0;
+          if (end - p < 4) { status = 3; break; }
+          std::memcpy(&count, p, 4); p += 4;
+          std::lock_guard<std::mutex> g(mu);
+          const uint64_t kMissing = ~0ULL;
+          bool ok = true;
+          for (uint32_t i = 0; i < count; ++i) {
+            uint32_t kl = 0;
+            if (end - p < 4) { ok = false; break; }
+            std::memcpy(&kl, p, 4); p += 4;
+            if (end - p < static_cast<long>(kl)) { ok = false; break; }
+            std::string k(p, kl); p += kl;
+            auto it = kv.find(k);
+            if (it == kv.end()) {
+              out.append(reinterpret_cast<const char*>(&kMissing), 8);
+            } else {
+              uint64_t vl = it->second.size();
+              out.append(reinterpret_cast<const char*>(&vl), 8);
+              out.append(it->second);
+            }
+          }
+          if (!ok) { status = 3; out.clear(); }
+          break;
+        }
+        case 9: {  // MFADD: batched accumulate, atomic per batch
+          const char* p = val.data();
+          const char* end = p + val.size();
+          uint32_t count = 0, rowbytes = 0;
+          if (end - p < 8) { status = 3; break; }
+          std::memcpy(&count, p, 4); p += 4;
+          std::memcpy(&rowbytes, p, 4); p += 4;
+          if (rowbytes % sizeof(float) != 0) { status = 3; break; }
+          std::lock_guard<std::mutex> g(mu);
+          bool ok = true;
+          for (uint32_t i = 0; i < count; ++i) {
+            uint32_t kl = 0;
+            if (end - p < 4) { ok = false; break; }
+            std::memcpy(&kl, p, 4); p += 4;
+            if (end - p < static_cast<long>(kl) + rowbytes) {
+              ok = false;
+              break;
+            }
+            std::string k(p, kl); p += kl;
+            const float* d = reinterpret_cast<const float*>(p);
+            p += rowbytes;
+            uint8_t st = 0;
+            auto it = kv.find(k);
+            if (it == kv.end()) {
+              st = 1;   // creation is SETNX-only, same as single FADD
+            } else if (it->second.size() != rowbytes) {
+              st = 3;
+            } else {
+              float* row = reinterpret_cast<float*>(&it->second[0]);
+              for (size_t j = 0; j < rowbytes / sizeof(float); ++j)
+                row[j] += d[j];
+            }
+            out.push_back(static_cast<char>(st));
+          }
+          if (!ok) { status = 3; out.clear(); }
+          else cv.notify_all();
+          break;
+        }
         default:
           status = 1;
       }
@@ -293,14 +404,17 @@ int ts_set(void* h, const char* key, const char* val, long vlen) {
       0, key, std::string(val, static_cast<size_t>(vlen)), &out);
 }
 
-// caller passes a buffer; returns -1 missing, -2 io error, -3 too small,
-// else the value length
+// caller passes a buffer; returns -1 missing, -2 io error, else the
+// value length.  If the value exceeds cap, returns -(length)-16 so the
+// caller can retry ONCE with an exact-size buffer (the bytes were
+// already received; re-requesting is one extra transfer, not log2 many)
 long ts_get(void* h, const char* key, char* buf, long cap) {
   std::string out;
   int st = static_cast<Client*>(h)->request(1, key, "", &out);
   if (st == 1) return -1;
   if (st != 0) return -2;
-  if (static_cast<long>(out.size()) > cap) return -3;
+  if (static_cast<long>(out.size()) > cap)
+    return -static_cast<long>(out.size()) - 16;
   std::memcpy(buf, out.data(), out.size());
   return static_cast<long>(out.size());
 }
@@ -324,6 +438,57 @@ int ts_add(void* h, const char* key, long long delta,
 int ts_delete(void* h, const char* key) {
   std::string out;
   return static_cast<Client*>(h)->request(4, key, "", &out);
+}
+
+// atomic f32-vector accumulate into an EXISTING row; *out (length n)
+// receives the post-add row.  returns 0 ok, 1 row missing, 2 io error,
+// 3 dimension mismatch
+int ts_fadd(void* h, const char* key, const float* delta, long n,
+            float* out_row) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(
+      6, key,
+      std::string(reinterpret_cast<const char*>(delta),
+                  static_cast<size_t>(n) * sizeof(float)),
+      &out);
+  if (st != 0) return st;
+  if (out.size() != static_cast<size_t>(n) * sizeof(float)) return 2;
+  std::memcpy(out_row, out.data(), out.size());
+  return 0;
+}
+
+// create-if-absent: returns 0 created, 1 already existed, 2 io error
+int ts_setnx(void* h, const char* key, const char* val, long vlen) {
+  std::string out;
+  return static_cast<Client*>(h)->request(
+      7, key, std::string(val, static_cast<size_t>(vlen)), &out);
+}
+
+// batched ops: payload formats documented at the top.  Same return
+// convention as ts_get (-1 unused, -2 io/malformed, -(len)-16 when the
+// response exceeds cap, else response length).
+long ts_mget(void* h, const char* payload, long plen, char* buf,
+             long cap) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(
+      8, "", std::string(payload, static_cast<size_t>(plen)), &out);
+  if (st != 0) return -2;
+  if (static_cast<long>(out.size()) > cap)
+    return -static_cast<long>(out.size()) - 16;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<long>(out.size());
+}
+
+long ts_mfadd(void* h, const char* payload, long plen, char* buf,
+              long cap) {
+  std::string out;
+  int st = static_cast<Client*>(h)->request(
+      9, "", std::string(payload, static_cast<size_t>(plen)), &out);
+  if (st != 0) return -2;
+  if (static_cast<long>(out.size()) > cap)
+    return -static_cast<long>(out.size()) - 16;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<long>(out.size());
 }
 
 }  // extern "C"

@@ -139,6 +139,51 @@ class TestBucketsAndHybridMesh:
         np.testing.assert_allclose(np.asarray(net[0].weight.grad.data),
                                    g_before, atol=0)
 
+    def test_unused_params_raise_without_flag(self):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(4)
+
+        class Partial(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.used = nn.Linear(8, 2)
+                self.unused = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.used(x)
+
+        net = Partial()
+        dp = dist.DataParallel(net)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        (dp(x) ** 2).mean().backward()
+        with pytest.raises(RuntimeError, match="find_unused_parameters"):
+            dp.apply_collective_grads()
+
+    def test_unused_params_zero_filled_with_flag(self):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(4)
+
+        class Partial(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.used = nn.Linear(8, 2)
+                self.unused = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.used(x)
+
+        net = Partial()
+        dp = dist.DataParallel(net, find_unused_parameters=True)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        (dp(x) ** 2).mean().backward()
+        assert net.unused.weight.grad is None
+        dp.apply_collective_grads()
+        # zero-filled so every rank all-reduces an identical bucket set
+        np.testing.assert_array_equal(
+            np.asarray(net.unused.weight.grad.data), 0.0)
+
     def test_tiny_buffer_splits_buckets(self):
         import paddle_tpu.distributed as dist
 

@@ -44,11 +44,38 @@ class TestElasticManager:
         a.deregister()
 
     def test_stale_heartbeat_counts_as_dead(self):
+        # liveness = the per-node counter keeps ADVANCING; a node whose
+        # counter stalls for > node_timeout (of the READER's monotonic
+        # clock — wall clocks never cross hosts) probes dead
         store = TCPStore(is_master=True, world_size=1)
         a = ElasticManager(store, job_id="j3", np=1, host="nodeA",
                            heartbeat_interval=10.0, node_timeout=0.3)
-        store.set("elastic/j3/nodeA", str(time.time() - 5.0))  # stale
-        assert not a.probe("nodeA")
+        store.add("elastic/j3/nodeA", 1)       # one beat, then silence
+        assert a.probe("nodeA")                # first sighting: alive
+        time.sleep(0.4)
+        assert not a.probe("nodeA")            # counter never advanced
+
+    def test_relaunch_not_fooled_by_stale_counter(self):
+        # a freshly-constructed manager (empty _seen, e.g. right after
+        # a relaunch) must NOT wait_for_np-succeed on a crashed peer
+        # whose counter merely exists
+        store = TCPStore(is_master=True, world_size=2)
+        dead = ElasticManager(store, job_id="j5", np=2, host="deadB",
+                              heartbeat_interval=0.1, node_timeout=0.3)
+        store.add("elastic/j5/deadB", 1)   # B beat once, then crashed
+        live = ElasticManager(store, job_id="j5", np=2, host="nodeA",
+                              heartbeat_interval=0.1, node_timeout=0.3)
+        live.register()
+        fresh = ElasticManager(store, job_id="j5", np=2, host="nodeA",
+                               heartbeat_interval=0.1, node_timeout=0.3)
+        assert not fresh.wait_for_np(["nodeA", "deadB"], timeout=1.5)
+        live.deregister()
+
+    def test_never_registered_is_dead(self):
+        store = TCPStore(is_master=True, world_size=1)
+        a = ElasticManager(store, job_id="j4", np=1, host="nodeA",
+                           heartbeat_interval=0.1, node_timeout=0.5)
+        assert not a.probe("ghost")
 
 
 WORKER_ELASTIC = """

@@ -114,6 +114,20 @@ class TestMetrics:
         assert top2 == 1.0       # both labels inside the top-2 sets
         assert m.name() == ["acc_top1", "acc_top2"]
 
+    def test_accuracy_rank3_sequence_logits(self):
+        # [B, S, V] logits must count B*S samples (advisor r3 finding:
+        # counting only B gave accuracies > 1)
+        from paddle_tpu.metric import Accuracy
+
+        m = Accuracy()
+        pred = np.zeros((2, 3, 4), np.float32)
+        pred[..., 0] = 1.0                       # argmax = 0 everywhere
+        label = np.zeros((2, 3), np.int64)
+        label[0, 0] = 1                          # one miss out of 6
+        acc = m.update(m.compute(pred, label))
+        assert abs(acc - 5 / 6) < 1e-6
+        assert 0.0 <= acc <= 1.0
+
     def test_precision_recall(self):
         from paddle_tpu.metric import Precision, Recall
 

@@ -83,3 +83,17 @@ class TestTCPStore:
         with pytest.raises(TimeoutError):
             TCPStore(host="127.0.0.1", port=1, is_master=False,
                      world_size=1, timeout=0.5)
+
+    def test_value_larger_than_client_buffer(self):
+        # values over the 1 MiB first-try buffer must round-trip (the
+        # server reports the exact length; one exact-size retry)
+        store = TCPStore(is_master=True, world_size=1)
+        big = bytes(range(256)) * (9 * 4096)   # 9 MiB
+        store.set("big", big)
+        assert store.get("big", blocking=False) == big
+
+    def test_set_if_absent(self):
+        store = TCPStore(is_master=True, world_size=1)
+        assert store.set_if_absent("k", b"first")
+        assert not store.set_if_absent("k", b"second")
+        assert store.get("k", blocking=False) == b"first"
