@@ -49,6 +49,8 @@ from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
 from . import ops
 from .ops import *  # noqa: F401,F403
 
+from . import autograd  # noqa: F401
+from .core.autograd import grad  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
@@ -68,28 +70,6 @@ from .framework_io import load, save  # noqa: F401
 
 # numpy-style creation with tensor return
 from .ops.creation import tensor_ctor as _tensor_ctor
-
-
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
-         create_graph=False, allow_unused=False):
-    """paddle.grad-style API: gradients of outputs w.r.t. inputs."""
-    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    for t in ins:
-        t._retain_grads = True
-    saved = [t.grad for t in ins]
-    for t in ins:
-        t.grad = None
-    for o in outs:
-        o.backward(retain_graph=retain_graph)
-    grads = [t.grad for t in ins]
-    for t, s in zip(ins, saved):
-        t.grad = s
-    if not allow_unused:
-        for g, t in zip(grads, ins):
-            if g is None:
-                raise RuntimeError("a requested input has no gradient path")
-    return grads
 
 
 def _patch_tensor_methods():

@@ -1,0 +1,122 @@
+"""User-facing autograd extras: PyLayer, functional grad, backward.
+
+Reference parity: python/paddle/autograd/py_layer.py (PyLayer /
+PyLayerContext over CPyLayer), python/paddle/autograd/__init__.py
+(backward, grad via partial_grad_engine.cc).
+
+TPU-native stance: a PyLayer is a user-defined op whose forward runs
+eagerly (any mix of framework ops and host code) and whose backward is
+user Python over Tensors.  It records the same TapeNode the dispatch
+layer records for built-in ops, so it composes with hooks, grad(),
+retain_graph and — when the user's backward is itself built from
+differentiable ops — grad-of-grad.
+"""
+from __future__ import annotations
+
+from ..core.autograd import TapeNode, grad, is_grad_enabled, no_grad
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "grad", "backward"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity: seed several roots at once."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(grad_tensor=g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Carries state from forward to backward (py_layer.py
+    ``PyLayerContext``)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace = False
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+
+class _PyLayerTapeNode(TapeNode):
+    __slots__ = ("py_backward",)
+
+    def __init__(self, op_name, vjp_fn, inputs, outputs, py_backward):
+        super().__init__(op_name, vjp_fn, inputs, outputs, call_fn=None)
+        self.py_backward = py_backward
+
+    def release(self):
+        super().release()
+        self.py_backward = None
+
+
+class PyLayer:
+    """Custom autograd op: subclass with @staticmethod forward(ctx, ...)
+    and backward(ctx, *output_grads); invoke via ``.apply(...)``.
+
+    backward must return one grad per Tensor positional input of
+    forward, in order (None for non-differentiable ones).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError(
+                    f"{cls.__name__}.forward must return Tensor(s), "
+                    f"got {type(o).__name__}")
+
+        track = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not track:
+            return outputs
+
+        wrapped = [Tensor(o.data, stop_gradient=False) for o in outs]
+        n_in = len(tensor_inputs)
+
+        def _normalize(gs):
+            gs = list(gs) if isinstance(gs, (tuple, list)) else [gs]
+            if len(gs) != n_in:
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(gs)} "
+                    f"gradient(s) for {n_in} Tensor input(s)")
+            return gs
+
+        def vjp_fn(ct_struct):
+            cts = list(ct_struct) if multi else [ct_struct]
+            with no_grad():
+                gs = _normalize(cls.backward(
+                    ctx, *[Tensor(c) for c in cts]))
+            return [g.data if isinstance(g, Tensor) else g for g in gs]
+
+        def py_backward(*ct_tensors):
+            # differentiable path for grad(create_graph=True): run the
+            # user's backward with the tape live
+            return _normalize(cls.backward(ctx, *ct_tensors))
+
+        node = _PyLayerTapeNode(cls.__name__, vjp_fn, tensor_inputs,
+                                wrapped, py_backward)
+        for w in wrapped:
+            w._node = node
+        return tuple(wrapped) if multi else wrapped[0]

@@ -19,7 +19,8 @@ import weakref
 
 import jax.numpy as jnp
 
-__all__ = ["no_grad", "enable_grad", "is_grad_enabled", "TapeNode", "run_backward"]
+__all__ = ["no_grad", "enable_grad", "is_grad_enabled", "TapeNode",
+           "run_backward", "grad"]
 
 
 class _GradMode(threading.local):
@@ -63,14 +64,21 @@ class TapeNode:
 
     ``inputs``: the Tensor objects the vjp differentiates w.r.t. (order =
     vjp cotangent order).  ``outputs``: weakrefs to produced Tensors.
+    ``call_fn``: the pure forward closure over the SAME inputs — kept so
+    ``grad(..., create_graph=True)`` can re-differentiate the forward
+    (second-order terms w.r.t. the inputs live in the forward, not in
+    the linear vjp closure).  Hook points per the reference's
+    GradNodeBase (grad_node_info.h:90) live on the Tensor
+    (``register_hook``), applied when its cotangent is finalized.
     """
 
-    __slots__ = ("op_name", "vjp_fn", "inputs", "out_refs", "out_avals",
-                 "n_outputs", "__weakref__")
+    __slots__ = ("op_name", "vjp_fn", "call_fn", "inputs", "out_refs",
+                 "out_avals", "n_outputs", "__weakref__")
 
-    def __init__(self, op_name, vjp_fn, inputs, outputs):
+    def __init__(self, op_name, vjp_fn, inputs, outputs, call_fn=None):
         self.op_name = op_name
         self.vjp_fn = vjp_fn
+        self.call_fn = call_fn
         self.inputs = list(inputs)
         self.out_refs = [weakref.ref(t) for t in outputs]
         # shape/dtype per output so zero cotangents survive output GC
@@ -85,35 +93,14 @@ class TapeNode:
 
     def release(self):
         self.vjp_fn = None
+        self.call_fn = None
         self.inputs = []
 
 
-def run_backward(root, grad=None, retain_graph=False):
-    """Reverse-mode walk from ``root`` (parity: egr::Backward, backward.cc:801)."""
-    root_node = root._node
-    if root_node is None:
-        # leaf with no history: grad flows nowhere; still set .grad for parity
-        if grad is None and root.data.size != 1:
-            raise RuntimeError(
-                "backward() on a non-scalar tensor requires an explicit grad"
-            )
-        if not root.stop_gradient:
-            g = jnp.ones_like(root.data) if grad is None else _as_array(grad)
-            root._accum_grad(g)
-        return
-
-    if grad is None:
-        if root.data.size != 1:
-            raise RuntimeError(
-                "backward() on a non-scalar tensor requires an explicit grad"
-            )
-        grad = jnp.ones_like(root.data)
-    else:
-        grad = _as_array(grad)
-
-    # topological order (DFS, iterative)
+def _topo_from(root_nodes):
+    """Reverse-topological op order (DFS, iterative)."""
     topo, seen = [], set()
-    stack = [(root_node, False)]
+    stack = [(n, False) for n in root_nodes]
     while stack:
         node, expanded = stack.pop()
         if expanded:
@@ -126,10 +113,38 @@ def run_backward(root, grad=None, retain_graph=False):
         for p in node.parents():
             if id(p) not in seen:
                 stack.append((p, False))
+    return topo
 
-    # cotangent accumulation keyed by tensor identity
-    cotangents: dict[int, object] = {id(root): grad}
-    keepalive = {id(root): root}
+
+def _apply_hooks(t, ct):
+    """Run a tensor's registered grad hooks over its finalized cotangent
+    (reference: GradNodeBase hook vector, grad_node_info.h:90).  A hook
+    returning non-None replaces the gradient."""
+    from .tensor import Tensor
+
+    for hook in t._grad_hooks:
+        r = hook(ct if isinstance(ct, Tensor) else Tensor(ct))
+        if r is not None:
+            ct = r.data if isinstance(r, Tensor) and not isinstance(
+                ct, Tensor) else r
+    return ct
+
+
+def _walk(seeds, retain_graph, apply_vjp, zeros, add):
+    """Shared reverse walk.  ``seeds``: [(Tensor, cotangent)] (tensors
+    keyed by identity — Tensor.__eq__ is elementwise).  The three
+    callbacks abstract raw-array math (run_backward) vs recorded eager
+    Tensor math (grad(create_graph=True)).  Returns the finalized
+    cotangent map {id(t): (t, ct)} with hooks applied."""
+    roots = [t._node for t, _ in seeds if t._node is not None]
+    topo = _topo_from(roots)
+
+    cotangents = {id(t): ct for t, ct in seeds}
+    keepalive = {id(t): t for t, _ in seeds}
+    hooked = set()
+    # seed hooks are NOT pre-fired here: a seed may also be an ancestor
+    # of another seed, so its cotangent is only final when its producer
+    # node is reached in the walk (leaf seeds fire in the end loop)
 
     for node in reversed(topo):
         cts_in = []
@@ -139,6 +154,12 @@ def run_backward(root, grad=None, retain_graph=False):
             ct = cotangents.get(id(t)) if t is not None else None
             if ct is not None:
                 has_any = True
+                # all consumers of t have run → its cotangent is final:
+                # fire hooks exactly once, replacing the propagated grad
+                if t._grad_hooks and id(t) not in hooked:
+                    ct = _apply_hooks(t, ct)
+                    cotangents[id(t)] = ct
+                    hooked.add(id(t))
             cts_in.append(ct)
         if not has_any:
             continue
@@ -148,28 +169,170 @@ def run_backward(root, grad=None, retain_graph=False):
                 "time: the saved graph was freed. Pass retain_graph=True to "
                 "the first backward() call."
             )
-        # build full cotangent tuple (zeros where an output is unused or GC'd)
-        cts = []
-        for i, ct in enumerate(cts_in):
-            if ct is None:
-                shape, dtype = node.out_avals[i]
-                cts.append(jnp.zeros(shape, dtype))
-            else:
-                cts.append(ct)
-        in_grads = node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+        cts = [zeros(*node.out_avals[i]) if ct is None else ct
+               for i, ct in enumerate(cts_in)]
+        in_grads = apply_vjp(node, cts)
         for t, g in zip(node.inputs, in_grads):
             if t.stop_gradient or g is None:
                 continue
             tid = id(t)
-            if t._node is None or t._retain_grads:
-                t._accum_grad(g)
             if tid in cotangents:
-                cotangents[tid] = cotangents[tid] + g
+                cotangents[tid] = add(cotangents[tid], g)
             else:
                 cotangents[tid] = g
                 keepalive[tid] = t
         if not retain_graph:
             node.release()
+
+    # leaves never pass through the node loop: fire their hooks now
+    for tid, t in keepalive.items():
+        if t._node is None and t._grad_hooks and tid not in hooked:
+            cotangents[tid] = _apply_hooks(t, cotangents[tid])
+            hooked.add(tid)
+    return {tid: (t, cotangents[tid]) for tid, t in keepalive.items()}
+
+
+def _raw_vjp(node, cts):
+    return node.vjp_fn(tuple(cts) if node.n_outputs > 1 else cts[0])
+
+
+def run_backward(root, grad=None, retain_graph=False):
+    """Reverse-mode walk from ``root`` (parity: egr::Backward, backward.cc:801).
+
+    Writes ``.grad`` on leaves (and retained intermediates) AFTER the
+    walk, so registered hooks see/modify the fully-accumulated gradient.
+    """
+    if grad is None and root.data.size != 1:
+        raise RuntimeError(
+            "backward() on a non-scalar tensor requires an explicit grad"
+        )
+    g = jnp.ones_like(root.data) if grad is None else _as_array(grad)
+
+    if root._node is None:
+        # leaf with no history: grad flows nowhere; still set .grad for parity
+        if not root.stop_gradient:
+            root._accum_grad(_apply_hooks(root, g))
+        return
+
+    final = _walk([(root, g)], retain_graph, _raw_vjp,
+                  zeros=lambda shape, dtype: jnp.zeros(shape, dtype),
+                  add=lambda a, b: a + b)
+    for tid, (t, ct) in final.items():
+        if t is root:
+            continue                      # loss.grad stays unset (parity)
+        if (t._node is None or t._retain_grads) and not t.stop_gradient:
+            t._accum_grad(ct)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """Functional gradients (parity: paddle.grad /
+    fluid/imperative/partial_grad_engine.cc PartialGradEngine).
+
+    With ``create_graph=True`` the returned grads carry tape history —
+    each node's gradient is computed by re-differentiating its stored
+    pure forward closure with the original inputs as live tape inputs,
+    so grad-of-grad (e.g. gradient penalties) is exact to any order.
+    Does NOT write ``.grad``.
+    """
+    from .tensor import Tensor
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    if grad_outputs is None:
+        gouts = [None] * len(outs)
+    else:
+        gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+            else [grad_outputs]
+        if len(gouts) != len(outs):
+            raise ValueError(
+                f"grad(): {len(outs)} outputs but {len(gouts)} "
+                f"grad_outputs — lengths must match")
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    seeds, seen_ids = [], set()
+    for o, go in zip(outs, gouts):
+        seed = jnp.ones_like(o.data) if go is None else _as_array(go)
+        if create_graph:
+            seed = go if isinstance(go, Tensor) else Tensor(
+                seed, stop_gradient=False)
+        if id(o) in seen_ids:
+            raise ValueError("duplicate tensor in grad() outputs")
+        seen_ids.add(id(o))
+        seeds.append((o, seed))
+
+    if create_graph:
+        apply_vjp = _recorded_vjp
+        zeros = lambda shape, dtype: Tensor(jnp.zeros(shape, dtype))  # noqa: E731
+        add = lambda a, b: a + b          # Tensor add → recorded on tape
+    else:
+        apply_vjp = _raw_vjp
+        zeros = lambda shape, dtype: jnp.zeros(shape, dtype)  # noqa: E731
+        add = lambda a, b: a + b
+
+    final = _walk(seeds, retain_graph, apply_vjp, zeros, add)
+
+    results = []
+    for t in ins:
+        entry = final.get(id(t))
+        if entry is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors was not used in the graph "
+                    "of outputs; pass allow_unused=True to get None for it"
+                )
+            results.append(None)
+            continue
+        ct = entry[1]
+        if not isinstance(ct, Tensor):
+            ct = Tensor(ct, stop_gradient=True)
+        results.append(ct)
+    return results   # always a list, one entry per input (paddle parity)
+
+
+def _recorded_vjp(node, cts):
+    """Differentiable grad step: re-run the node's pure forward under
+    jax.vjp with (original inputs, cotangents) as EAGER op inputs, so
+    the produced grads join the tape and d²/dx² flows through both the
+    forward's curvature and the cotangent path."""
+    from . import dispatch
+    from .tensor import Tensor
+
+    if getattr(node, "py_backward", None) is not None:
+        # PyLayer: its backward is user Python over Tensors — run it
+        # live (grad mode on); differentiability is whatever the user's
+        # backward is composed of (reference py_layer.py semantics)
+        cts_t = [c if isinstance(c, Tensor) else Tensor(c) for c in cts]
+        out = node.py_backward(*cts_t)
+        out = out if isinstance(out, (tuple, list)) else (out,)
+        return list(out)
+
+    if node.call_fn is None:
+        raise RuntimeError(
+            f"op '{node.op_name}': create_graph=True needs the forward "
+            "closure, but the graph was freed (backward without "
+            "retain_graph?)")
+
+    import jax
+
+    n_in = len(node.inputs)
+    multi = node.n_outputs > 1
+    call_fn = node.call_fn
+
+    def pure(*flat):
+        xs, ct_flat = flat[:n_in], flat[n_in:]
+        _, vjp = jax.vjp(call_fn, *xs)
+        gs = vjp(tuple(ct_flat) if multi else ct_flat[0])
+        # single-input: return the bare array (a 1-tuple output would
+        # desync this op's own vjp tree structure on the next order)
+        return gs[0] if n_in == 1 else tuple(gs)
+
+    pure.__name__ = f"{node.op_name}_grad"
+    out = dispatch._eager_run(pure.__name__, pure, True,
+                              tuple(node.inputs) + tuple(cts), {})
+    return list(out) if isinstance(out, tuple) else [out]
 
 
 def _as_array(x):

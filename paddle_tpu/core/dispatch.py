@@ -124,7 +124,10 @@ def _eager_run(op_name, pure_fn, differentiable, args, kwargs):
         out_is_tuple = isinstance(out, (tuple, list))
         outs = list(out) if out_is_tuple else [out]
         wrapped = [Tensor(o, stop_gradient=False) for o in outs]
-        node = TapeNode(op_name, vjp_fn, diff_tensors, wrapped)
+        # call_fn kept for grad(create_graph=True): second-order terms
+        # need the forward re-differentiated, not the linear vjp closure
+        node = TapeNode(op_name, vjp_fn, diff_tensors, wrapped,
+                        call_fn=call)
         for w in wrapped:
             w._node = node
     else:

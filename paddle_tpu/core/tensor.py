@@ -26,7 +26,8 @@ __all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
 
 class Tensor:
     __slots__ = ("data", "stop_gradient", "grad", "_node", "name",
-                 "persistable", "_retain_grads", "__weakref__")
+                 "persistable", "_retain_grads", "_grad_hooks",
+                 "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None, place=None):
         if isinstance(data, Tensor):
@@ -42,6 +43,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._retain_grads = False
+        self._grad_hooks = ()    # shared empty tuple: no alloc on hot path
 
     # ------------------------------------------------------------------ meta
     @property
@@ -120,6 +122,14 @@ class Tensor:
 
     def retain_grads(self):
         self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Register ``hook(grad) -> grad | None`` fired when this
+        tensor's gradient is finalized during backward (parity:
+        Tensor.register_hook over egr::GradNodeBase hooks,
+        grad_node_info.h:90).  Returns a handle with ``.remove()``."""
+        self._grad_hooks = tuple(self._grad_hooks) + (hook,)
+        return _HookHandle(self, hook)
 
     def clear_grad(self):
         self.grad = None
@@ -275,6 +285,23 @@ class Tensor:
     # jax pytree-friendly: allow jnp.asarray(tensor)
     def __jax_array__(self):
         return self.data
+
+
+class _HookHandle:
+    __slots__ = ("_ref", "_hook")
+
+    def __init__(self, tensor, hook):
+        import weakref
+
+        self._ref = weakref.ref(tensor)
+        self._hook = hook
+
+    def remove(self):
+        t = self._ref()
+        if t is not None:
+            t._grad_hooks = tuple(h for h in t._grad_hooks
+                                  if h is not self._hook)
+        self._hook = None
 
 
 class Parameter(Tensor):

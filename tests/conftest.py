@@ -17,6 +17,17 @@ import jax
 # the axon TPU plugin ignores JAX_PLATFORMS env; the config knob wins
 jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: repeat suite runs skip XLA recompiles
+# (reference quarantines slow tests via tools/parallel_UT_rule.py; our
+# equivalent is @pytest.mark.slow + this cache)
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# keep XLA:CPU AOT blobs out of the cache: reloading them trips a
+# machine-feature check (prefer-no-scatter/-gather) and spams stderr
+jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+
 import numpy as np
 import pytest
 
