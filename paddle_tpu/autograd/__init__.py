@@ -13,19 +13,28 @@ differentiable ops — grad-of-grad.
 """
 from __future__ import annotations
 
-from ..core.autograd import TapeNode, grad, is_grad_enabled, no_grad
+from ..core.autograd import (TapeNode, grad, is_grad_enabled, no_grad,
+                             run_backward_multi)
 from ..core.tensor import Tensor
 
 __all__ = ["PyLayer", "PyLayerContext", "grad", "backward"]
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
-    """paddle.autograd.backward parity: seed several roots at once."""
+    """paddle.autograd.backward parity: seed several roots into ONE
+    joint walk, so roots sharing subgraph accumulate correctly (a
+    per-root loop would free shared nodes after the first root)."""
     tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
-    for t, g in zip(tensors, grad_tensors):
-        t.backward(grad_tensor=g, retain_graph=retain_graph)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"backward(): {len(tensors)} tensors but {len(grad_tensors)} "
+            f"grad_tensors — lengths must match")
+    run_backward_multi(list(zip(tensors, grad_tensors)),
+                       retain_graph=retain_graph)
 
 
 class PyLayerContext:

@@ -130,14 +130,29 @@ def _apply_hooks(t, ct):
     return ct
 
 
-def _walk(seeds, retain_graph, apply_vjp, zeros, add):
+def _walk(seeds, retain_graph, apply_vjp, zeros, add, input_ids=None):
     """Shared reverse walk.  ``seeds``: [(Tensor, cotangent)] (tensors
     keyed by identity — Tensor.__eq__ is elementwise).  The three
     callbacks abstract raw-array math (run_backward) vs recorded eager
     Tensor math (grad(create_graph=True)).  Returns the finalized
-    cotangent map {id(t): (t, ct)} with hooks applied."""
+    cotangent map {id(t): (t, ct)} with hooks applied.
+
+    ``input_ids`` (partial-grad mode, reference partial_grad_engine.cc):
+    ids of the target input tensors — the walk then differentiates only
+    nodes on an outputs→inputs path.  A node is needed iff it directly
+    consumes a target or any producer of its inputs is needed; every
+    consumer feeding a needed producer is itself needed by that same
+    recurrence, so skipping the rest leaves target cotangents exact."""
     roots = [t._node for t, _ in seeds if t._node is not None]
     topo = _topo_from(roots)
+
+    needed = None
+    if input_ids is not None:
+        needed = {}
+        for node in topo:                 # parents precede children
+            needed[id(node)] = (
+                any(id(t) in input_ids for t in node.inputs)
+                or any(needed.get(id(p), False) for p in node.parents()))
 
     cotangents = {id(t): ct for t, ct in seeds}
     keepalive = {id(t): t for t, _ in seeds}
@@ -147,6 +162,10 @@ def _walk(seeds, retain_graph, apply_vjp, zeros, add):
     # node is reached in the walk (leaf seeds fire in the end loop)
 
     for node in reversed(topo):
+        if needed is not None and not needed[id(node)]:
+            # off the outputs→inputs paths: contributes nothing to the
+            # targets; left unreleased like any other unvisited node
+            continue
         cts_in = []
         has_any = False
         for ref in node.out_refs:
@@ -202,23 +221,40 @@ def run_backward(root, grad=None, retain_graph=False):
     Writes ``.grad`` on leaves (and retained intermediates) AFTER the
     walk, so registered hooks see/modify the fully-accumulated gradient.
     """
-    if grad is None and root.data.size != 1:
-        raise RuntimeError(
-            "backward() on a non-scalar tensor requires an explicit grad"
-        )
-    g = jnp.ones_like(root.data) if grad is None else _as_array(grad)
+    run_backward_multi([(root, grad)], retain_graph)
 
-    if root._node is None:
-        # leaf with no history: grad flows nowhere; still set .grad for parity
-        if not root.stop_gradient:
-            root._accum_grad(_apply_hooks(root, g))
-        return
 
-    final = _walk([(root, g)], retain_graph, _raw_vjp,
+def run_backward_multi(pairs, retain_graph=False):
+    """Seed several roots into ONE joint walk (parity:
+    paddle.autograd.backward → egr::Backward's multi-tensor entry).
+
+    A single walk is load-bearing: sequential per-root backwards would
+    release shared subgraph nodes after the first root and fail on the
+    second.  Duplicate roots accumulate their seed cotangents."""
+    agg, order = {}, []
+    for root, grad in pairs:
+        if grad is None and root.data.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad"
+            )
+        g = jnp.ones_like(root.data) if grad is None else _as_array(grad)
+        tid = id(root)
+        if tid in agg:
+            agg[tid] = (root, agg[tid][1] + g)
+        else:
+            agg[tid] = (root, g)
+            order.append(tid)
+
+    # leaf roots (no history) fall through the walk's end loop, which
+    # fires their hooks; they get .grad below like any finalized leaf
+    node_root_ids = {tid for tid in order
+                     if agg[tid][0]._node is not None}
+    seeds = [agg[tid] for tid in order]
+    final = _walk(seeds, retain_graph, _raw_vjp,
                   zeros=lambda shape, dtype: jnp.zeros(shape, dtype),
                   add=lambda a, b: a + b)
     for tid, (t, ct) in final.items():
-        if t is root:
+        if tid in node_root_ids:
             continue                      # loss.grad stays unset (parity)
         if (t._node is None or t._retain_grads) and not t.stop_gradient:
             t._accum_grad(ct)
@@ -272,7 +308,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         zeros = lambda shape, dtype: jnp.zeros(shape, dtype)  # noqa: E731
         add = lambda a, b: a + b
 
-    final = _walk(seeds, retain_graph, apply_vjp, zeros, add)
+    final = _walk(seeds, retain_graph, apply_vjp, zeros, add,
+                  input_ids={id(t) for t in ins})
 
     results = []
     for t in ins:

@@ -31,3 +31,5 @@ from .collective import (  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import fleet  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import shard_tensor, reshard  # noqa: F401

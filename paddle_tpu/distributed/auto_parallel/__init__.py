@@ -1,0 +1,30 @@
+"""Semi-automatic SPMD parallelization (auto_parallel).
+
+The reference's 18k-LoC subsystem (python/paddle/distributed/auto_parallel/:
+Engine engine.py:50, Completer completion.py:126, Partitioner
+partitioner.py:37, Resharder reshard.py:603, Planner planner.py:826)
+exists because on GPU someone must decide, per tensor and per op, which
+rank owns which shard and which NCCL calls move data between layouts.
+
+On TPU the division of labor is different and most of that code has a
+compiler underneath it:
+
+- **Completer**  → :class:`ShardingPropagator` (propagation.py): sparse
+  user annotations are propagated to a full PartitionSpec tree over the
+  traced jaxpr via factor-group union-find.
+- **Partitioner** → GSPMD: jit ``in_shardings`` from the completed specs;
+  XLA partitions every op and inserts the collectives.
+- **Resharder**  → :func:`reshard`: ``jax.device_put`` between
+  NamedShardings, cross-mesh included (api.py).
+- **Planner**    → out of scope by design: the cost-model search over
+  layouts is XLA's auto-spmd territory; our propagator keeps the user in
+  control with ≤ a handful of annotations instead.
+- **Engine**     → :func:`parallelize` (complete → jit), composing with
+  the hand-tuned :class:`~paddle_tpu.distributed.engine.HybridEngine` for
+  layouts that want explicit control.
+"""
+from .propagation import ShardingPropagator, complete
+from .api import shard_tensor, reshard, parallelize
+
+__all__ = ["ShardingPropagator", "complete", "shard_tensor", "reshard",
+           "parallelize"]

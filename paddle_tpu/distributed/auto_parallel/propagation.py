@@ -1,0 +1,485 @@
+"""Sharding propagation — the semi-auto SPMD "Completer" on TPU.
+
+Reference parity: python/paddle/distributed/auto_parallel/completion.py:126
+(``Completer.complete_forward_annotation`` — iterative forward/backward
+sweeps pushing per-tensor ``dims_mapping`` through each op's SPMD rule until
+fixpoint) and partitioner.py:37 (``Partitioner`` — rewriting the serial
+program into per-rank programs with comm ops).
+
+TPU-first redesign: instead of per-op forward/backward rule pairs run to
+fixpoint over a ProgramDesc, we trace the user's loss function to a jaxpr
+and build ONE union-find over ``(tensor, dim)`` factor groups: every
+equation contributes "these dims must share a mesh axis" links (the einsum
+factor structure of the primitive), and sparse user annotations seed axis
+names into the classes they touch.  A single pass then reads off a complete
+PartitionSpec for every input — parameters included.  Union-find is the
+closure of the reference's fixpoint iteration (propagation here is
+direction-free, so one pass IS the fixpoint), and the *partitioning* half of
+the reference collapses into GSPMD: handing the completed specs to jit's
+``in_shardings`` makes XLA insert the collectives partitioner.py writes by
+hand.
+
+Conservative by construction: an equation with no rule contributes no links,
+which can only under-shard (replicate) — never mis-shard.  GSPMD remains
+the correctness backstop for any layout we emit.
+"""
+from __future__ import annotations
+
+import fnmatch
+import math
+
+import jax
+import jax.extend.core
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ShardingPropagator", "complete"]
+
+
+# --------------------------------------------------------------- union-find
+
+
+class _UnionFind:
+    def __init__(self):
+        self._parent = {}
+
+    def find(self, k):
+        p = self._parent
+        path = []
+        while k in p:
+            path.append(k)
+            k = p[k]
+        for q in path:              # path compression
+            p[q] = k
+        return k
+
+    def union(self, a, b):
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self._parent[ra] = rb
+
+
+def _aval(v):
+    return v.aval
+
+
+def _is_lit(v):
+    return isinstance(v, jax.extend.core.Literal)
+
+
+# ------------------------------------------------------------- eqn → links
+
+
+def _grouped_factors(src_shape, dst_shape):
+    """Greedy left-to-right grouping of a reshape: yields (src_dims,
+    dst_dims) lists whose element products match.  The standard two-pointer
+    walk used by every reshape-sharding rule."""
+    i = j = 0
+    while i < len(src_shape) or j < len(dst_shape):
+        si, sj = [], []
+        pi = pj = 1
+        if i < len(src_shape):
+            pi *= src_shape[i]; si.append(i); i += 1
+        if j < len(dst_shape):
+            pj *= dst_shape[j]; sj.append(j); j += 1
+        while pi != pj:
+            if pi < pj:
+                if i >= len(src_shape):
+                    return
+                pi *= src_shape[i]; si.append(i); i += 1
+            else:
+                if j >= len(dst_shape):
+                    return
+                pj *= dst_shape[j]; sj.append(j); j += 1
+        # absorb trailing size-1 dims into the current group
+        while i < len(src_shape) and src_shape[i] == 1:
+            si.append(i); i += 1
+        while j < len(dst_shape) and dst_shape[j] == 1:
+            sj.append(j); j += 1
+        yield si, sj
+
+
+class _LinkBuilder:
+    """Walks a jaxpr (recursing into sub-jaxprs) emitting union-find links.
+
+    A link between (var_a, dim_i) and (var_b, dim_j) asserts: if one is
+    sharded over a mesh axis, the other lives on that same axis shard-for-
+    shard — exactly the reference's "same dims_mapping entry" relation that
+    completion.py's per-op rules encode pairwise.
+    """
+
+    def __init__(self, uf: _UnionFind):
+        self.uf = uf
+
+    def link(self, va, da, vb, db):
+        if _is_lit(va) or _is_lit(vb):
+            return
+        self.uf.union((va, da), (vb, db))
+
+    # ---- per-primitive rules ------------------------------------------
+    def walk(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            rule = getattr(self, "_r_" + eqn.primitive.name, None)
+            try:
+                if rule is not None:
+                    rule(eqn)
+                else:
+                    self._r_default(eqn)
+            except Exception:
+                # a malformed/unexpected eqn shape only costs inference
+                # power (replication), never correctness
+                continue
+
+    def _r_default(self, eqn):
+        """Rank-aligned elementwise rule: covers all elementwise primitives
+        (add, mul, tanh, select_n, compares, convert_element_type, ...) and
+        — deliberately — pallas_call kernels whose operands match the
+        output shape (flash attention's q/k/v/o all [B,H,S,hd]).  Size-1
+        dims (lax implicit broadcasting after jnp's rank promotion) are
+        left unlinked."""
+        for ov in eqn.outvars:
+            oshape = _aval(ov).shape
+            if not oshape:
+                continue
+            for iv in eqn.invars:
+                if _is_lit(iv):
+                    continue
+                ishape = getattr(_aval(iv), "shape", None)
+                if ishape is None or len(ishape) != len(oshape):
+                    continue
+                for d in range(len(oshape)):
+                    if ishape[d] == oshape[d]:
+                        self.link(iv, d, ov, d)
+
+    def _r_dot_general(self, eqn):
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs, rhs = eqn.invars[:2]
+        out = eqn.outvars[0]
+        nl = len(_aval(lhs).shape)
+        nr = len(_aval(rhs).shape)
+        # contracting dims pair up lhs↔rhs (the psum factor)
+        for a, b in zip(lc, rc):
+            self.link(lhs, a, rhs, b)
+        o = 0
+        for a, b in zip(lb, rb):            # batch dims: lhs↔rhs↔out
+            self.link(lhs, a, rhs, b)
+            self.link(lhs, a, out, o)
+            o += 1
+        for a in range(nl):                 # lhs free dims → out
+            if a not in lc and a not in lb:
+                self.link(lhs, a, out, o)
+                o += 1
+        for b in range(nr):                 # rhs free dims → out
+            if b not in rc and b not in rb:
+                self.link(rhs, b, out, o)
+                o += 1
+
+    def _r_transpose(self, eqn):
+        perm = eqn.params["permutation"]
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        for o, i in enumerate(perm):
+            self.link(iv, i, ov, o)
+
+    def _r_broadcast_in_dim(self, eqn):
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        ishape = _aval(iv).shape
+        oshape = _aval(ov).shape
+        for i, o in enumerate(eqn.params["broadcast_dimensions"]):
+            if ishape[i] == oshape[o]:      # not a size-1 expansion
+                self.link(iv, i, ov, o)
+
+    def _reduce(self, eqn):
+        axes = set(eqn.params["axes"])
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        o = 0
+        for i in range(len(_aval(iv).shape)):
+            if i not in axes:
+                self.link(iv, i, ov, o)
+                o += 1
+
+    _r_reduce_sum = _r_reduce_max = _r_reduce_min = _r_reduce_prod = _reduce
+    _r_reduce_and = _r_reduce_or = _r_argmax = _r_argmin = _reduce
+
+    def _r_squeeze(self, eqn):
+        dims = set(eqn.params["dimensions"])
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        o = 0
+        for i in range(len(_aval(iv).shape)):
+            if i not in dims:
+                self.link(iv, i, ov, o)
+                o += 1
+
+    def _r_reshape(self, eqn):
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        if eqn.params.get("dimensions") is not None:
+            return                          # fused transpose: skip
+        ishape, oshape = _aval(iv).shape, _aval(ov).shape
+        for si, sj in _grouped_factors(ishape, oshape):
+            # link the leading (major) factor on each side: sharding the
+            # major factor of a split/merge is the only layout-preserving
+            # choice, and resolution re-checks divisibility
+            ci = [d for d in si if ishape[d] > 1] or si[:1]
+            cj = [d for d in sj if oshape[d] > 1] or sj[:1]
+            if ci and cj:
+                self.link(iv, ci[0], ov, cj[0])
+                # 1:1 groups of equal rank link every dim
+                if len(ci) == len(cj) and all(
+                        ishape[a] == oshape[b] for a, b in zip(ci, cj)):
+                    for a, b in zip(ci[1:], cj[1:]):
+                        self.link(iv, a, ov, b)
+
+    def _r_slice(self, eqn):
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        ishape, oshape = _aval(iv).shape, _aval(ov).shape
+        for d in range(len(ishape)):
+            if ishape[d] == oshape[d]:      # full-size dims only
+                self.link(iv, d, ov, d)
+
+    def _r_dynamic_slice(self, eqn):
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        ishape, oshape = _aval(iv).shape, _aval(ov).shape
+        for d in range(len(ishape)):
+            if ishape[d] == oshape[d]:
+                self.link(iv, d, ov, d)
+
+    def _r_dynamic_update_slice(self, eqn):
+        op, upd = eqn.invars[0], eqn.invars[1]
+        ov = eqn.outvars[0]
+        oshape = _aval(ov).shape
+        for d in range(len(oshape)):
+            self.link(op, d, ov, d)
+            if _aval(upd).shape[d] == oshape[d]:
+                self.link(upd, d, ov, d)
+
+    def _r_concatenate(self, eqn):
+        cd = eqn.params["dimension"]
+        ov = eqn.outvars[0]
+        for iv in eqn.invars:
+            for d in range(len(_aval(ov).shape)):
+                if d != cd:
+                    self.link(iv, d, ov, d)
+
+    def _r_pad(self, eqn):
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        for d, (lo, hi, interior) in enumerate(eqn.params["padding_config"]):
+            if lo == hi == interior == 0:
+                self.link(iv, d, ov, d)
+
+    def _r_gather(self, eqn):
+        dn = eqn.params["dimension_numbers"]
+        operand, indices = eqn.invars[0], eqn.invars[1]
+        ov = eqn.outvars[0]
+        slice_sizes = eqn.params["slice_sizes"]
+        oshape = _aval(operand).shape
+        offset_dims = dn.offset_dims
+        batch_out = [d for d in range(len(_aval(ov).shape))
+                     if d not in offset_dims]
+        # output batch dims ↔ indices dims (minus the index-vector dim)
+        idx_dims = [d for d in range(len(_aval(indices).shape) - 1)]
+        for od, idim in zip(batch_out, idx_dims):
+            self.link(indices, idim, ov, od)
+        # full-slice operand dims ↔ the offset dims, in order
+        full = [d for d in range(len(oshape))
+                if d not in dn.collapsed_slice_dims
+                and slice_sizes[d] == oshape[d]]
+        for opd, od in zip(full, offset_dims):
+            self.link(operand, opd, ov, od)
+
+    # ---- structured control flow: recurse, aligning boundaries ---------
+    def _inner(self, sub):
+        if hasattr(sub, "jaxpr"):           # ClosedJaxpr
+            return sub.jaxpr
+        return sub
+
+    def _align(self, outers, inners):
+        for o, i in zip(outers, inners):
+            if _is_lit(o):
+                continue
+            osh = getattr(_aval(o), "shape", None)
+            ish = getattr(_aval(i), "shape", None)
+            if osh is not None and osh == ish:
+                for d in range(len(osh)):
+                    self.link(o, d, i, d)
+
+    def _r_scan(self, eqn):
+        inner = self._inner(eqn.params["jaxpr"])
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        self._align(eqn.invars[:nc + ncar], inner.invars[:nc + ncar])
+        # xs/ys: outer leading dim is the scan axis — shift by one
+        for o, i in zip(eqn.invars[nc + ncar:], inner.invars[nc + ncar:]):
+            if _is_lit(o):
+                continue
+            for d in range(len(_aval(i).shape)):
+                self.link(o, d + 1, i, d)
+        self._align(eqn.outvars[:ncar], inner.outvars[:ncar])
+        for o, i in zip(eqn.outvars[ncar:], inner.outvars[ncar:]):
+            for d in range(len(_aval(i).shape)):
+                self.link(o, d + 1, i, d)
+        # the loop ties carry-out back to carry-in: union them so a layout
+        # is consistent across iterations (the reference re-sweeps instead)
+        self._align(inner.invars[nc:nc + ncar], inner.outvars[:ncar])
+        self.walk(inner)
+
+    def _r_while(self, eqn):
+        body = self._inner(eqn.params["body_jaxpr"])
+        nb = eqn.params["body_nconsts"]
+        ncc = eqn.params["cond_nconsts"]
+        carry = eqn.invars[ncc + nb:]
+        self._align(carry, body.invars[nb:])
+        self._align(eqn.outvars, body.outvars)
+        self._align(body.invars[nb:], body.outvars)
+        self.walk(body)
+
+    def _r_cond(self, eqn):
+        for br in eqn.params["branches"]:
+            inner = self._inner(br)
+            self._align(eqn.invars[1:], inner.invars)
+            self._align(eqn.outvars, inner.outvars)
+            self.walk(inner)
+
+    def _call_like(self, eqn):
+        sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+               or eqn.params.get("fun_jaxpr"))
+        if sub is None:
+            return self._r_default(eqn)
+        inner = self._inner(sub)
+        invars = eqn.invars
+        if len(invars) != len(inner.invars):
+            if len(invars) > len(inner.invars):
+                invars = invars[-len(inner.invars):]
+            else:
+                return
+        self._align(invars, inner.invars)
+        self._align(eqn.outvars, inner.outvars[:len(eqn.outvars)])
+        self.walk(inner)
+
+    _r_pjit = _r_remat = _r_remat2 = _r_checkpoint = _call_like
+    _r_custom_jvp_call = _r_custom_vjp_call = _call_like
+    _r_custom_jvp_call_jaxpr = _r_custom_vjp_call_jaxpr = _call_like
+    _r_closed_call = _r_core_call = _r_xla_call = _call_like
+
+
+# ----------------------------------------------------------- the propagator
+
+
+def _path_str(path):
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(str(p.key))
+        elif hasattr(p, "idx"):
+            out.append(str(p.idx))
+        elif hasattr(p, "name"):
+            out.append(str(p.name))
+        else:
+            out.append(str(p))
+    return "/".join(out)
+
+
+class ShardingPropagator:
+    """Complete a full PartitionSpec tree from sparse annotations.
+
+    ``mesh`` supplies axis names/sizes for validity checks; annotations map
+    fnmatch-style path patterns (over the flattened args pytree, e.g.
+    ``"0/blocks/qkv_w"`` or ``"*qkv_w"``) to PartitionSpecs.
+    """
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self.axis_sizes = dict(mesh.shape)
+
+    def complete(self, fn, args, annotations, *, return_out_specs=False):
+        closed = jax.make_jaxpr(fn)(*args)
+        leaves_p, treedef = jax.tree_util.tree_flatten_with_path(tuple(args))
+        paths = [_path_str(p) for p, _ in leaves_p]
+        leaves = [l for _, l in leaves_p]
+        invars = closed.jaxpr.invars
+        assert len(invars) == len(leaves), \
+            f"flattened args ({len(leaves)}) != jaxpr invars ({len(invars)})"
+
+        uf = _UnionFind()
+        _LinkBuilder(uf).walk(closed.jaxpr)
+
+        # seed axes from annotations
+        matched = set()
+        class_axis = {}          # root -> (axis_or_tuple, owner_path)
+        for pat, spec in annotations.items():
+            hits = [i for i, p in enumerate(paths)
+                    if fnmatch.fnmatch(p, pat)]
+            if not hits:
+                raise ValueError(
+                    f"annotation {pat!r} matches no input; paths are like "
+                    f"{paths[:5]}...")
+            matched.add(pat)
+            for i in hits:
+                shape = np.shape(leaves[i])
+                entries = tuple(spec) + (None,) * (len(shape) - len(spec))
+                if len(entries) > len(shape):
+                    raise ValueError(
+                        f"{pat!r}: spec {spec} longer than rank of "
+                        f"{paths[i]} {shape}")
+                for d, ax in enumerate(entries):
+                    if ax is None:
+                        continue
+                    self._check_div(shape[d], ax, paths[i], d)
+                    root = uf.find((invars[i], d))
+                    prev = class_axis.get(root)
+                    if prev is not None and prev[0] != ax:
+                        raise ValueError(
+                            f"conflicting annotations: {paths[i]} dim {d} "
+                            f"wants {ax!r} but its factor group already "
+                            f"carries {prev[0]!r} (from {prev[1]})")
+                    class_axis[root] = (ax, f"{paths[i]}[{d}]")
+
+        def spec_for(var, shape):
+            used = set()
+            entries = []
+            for d, size in enumerate(shape):
+                got = class_axis.get(uf.find((var, d)))
+                ax = got[0] if got else None
+                if ax is not None:
+                    flat = ax if isinstance(ax, tuple) else (ax,)
+                    if (any(a in used for a in flat)
+                            or not self._divides(size, ax)):
+                        ax = None
+                    else:
+                        used.update(flat)
+                entries.append(ax)
+            while entries and entries[-1] is None:
+                entries.pop()
+            return P(*entries)
+
+        flat_specs = [spec_for(invars[i], np.shape(leaves[i]))
+                      for i in range(len(leaves))]
+        specs = jax.tree_util.tree_unflatten(treedef, flat_specs)
+        if return_out_specs:
+            outs = [spec_for(v, _aval(v).shape) for v in closed.jaxpr.outvars]
+            return specs, outs
+        return specs
+
+    # ------------------------------------------------------------- helpers
+    def _axis_size(self, ax):
+        if isinstance(ax, tuple):
+            return math.prod(self.axis_sizes[a] for a in ax)
+        return self.axis_sizes[ax]
+
+    def _divides(self, dim, ax):
+        return dim % self._axis_size(ax) == 0
+
+    def _check_div(self, dim, ax, path, d):
+        unknown = [a for a in (ax if isinstance(ax, tuple) else (ax,))
+                   if a not in self.axis_sizes]
+        if unknown:
+            raise ValueError(f"unknown mesh axis {unknown} in annotation "
+                             f"for {path}[{d}] (mesh has "
+                             f"{list(self.axis_sizes)})")
+        if not self._divides(dim, ax):
+            raise ValueError(
+                f"{path} dim {d} of size {dim} not divisible by axis "
+                f"{ax!r} (size {self._axis_size(ax)})")
+
+
+def complete(fn, args, annotations, mesh, **kw):
+    """Functional form of ShardingPropagator.complete."""
+    return ShardingPropagator(mesh).complete(fn, args, annotations, **kw)

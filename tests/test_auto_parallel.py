@@ -1,0 +1,232 @@
+"""Semi-auto SPMD: sharding propagation + runtime reshard.
+
+Reference parity: the auto_parallel planning tests
+(unittests/test_auto_parallel_completion.py — Completer emits dist_attr for
+every tensor of a toy MLP from sparse annotations;
+test_auto_parallel_reshard.py — Resharder moves tensors between meshes).
+Here: ShardingPropagator completes PartitionSpec trees over the traced
+jaxpr, parity is sharded-vs-single-device loss equality, and reshard is
+device_put between NamedShardings.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import (
+    ShardingPropagator, complete, parallelize, reshard, shard_tensor)
+from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_forward, gpt_init
+
+
+def mesh_2x4():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+def mlp_loss(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    h = h @ params["w2"] + params["b2"]
+    return (h.astype(jnp.float32) ** 2).mean()
+
+
+def mlp_params(key=0):
+    ks = jax.random.split(jax.random.key(key), 4)
+    return {
+        "w1": jax.random.normal(ks[0], (16, 32), jnp.float32) * 0.3,
+        "b1": jax.random.normal(ks[1], (32,), jnp.float32) * 0.1,
+        "w2": jax.random.normal(ks[2], (32, 16), jnp.float32) * 0.3,
+        "b2": jax.random.normal(ks[3], (16,), jnp.float32) * 0.1,
+    }
+
+
+class TestCompletion:
+    def test_mlp_megatron_from_two_annotations(self):
+        """Annotating the input batch dim + the first weight's output dim
+        must complete the classic column→row layout (completion.py's MLP
+        fixture)."""
+        mesh = mesh_2x4()
+        params = mlp_params()
+        x = jnp.ones((8, 16))
+        specs = complete(mlp_loss, (params, x),
+                         {"*w1": P(None, "mp"), "1": P("dp")}, mesh)
+        pspecs, xspec = specs
+        assert xspec == P("dp")
+        assert pspecs["w1"] == P(None, "mp")
+        assert pspecs["b1"] == P("mp")          # column bias follows
+        assert pspecs["w2"] == P("mp")          # row-parallel inferred
+        assert pspecs["b2"] == P()              # replicated output bias
+
+    def test_gpt_full_layout_from_three_annotations(self):
+        """tokens→dp + qkv_w/up_w→column must complete the whole Megatron
+        block layout (row proj/down, mp biases) through scan + remat +
+        attention."""
+        mesh = mesh_2x4()
+        cfg = dataclasses.replace(GPT_CONFIGS["tiny"], use_flash=False)
+        params = gpt_init(cfg)
+        toks = jnp.zeros((4, 32), jnp.int32)
+
+        def loss(params, tokens):
+            logits = gpt_forward(cfg, params, tokens)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tgt = jnp.roll(tokens, -1, 1)
+            return -jnp.take_along_axis(logp, tgt[..., None], -1).mean()
+
+        specs, _ = complete(
+            loss, (params, toks),
+            {"0/blocks/qkv_w": P(None, None, "mp"),
+             "0/blocks/up_w": P(None, None, "mp"),
+             "1": P("dp")}, mesh)
+        b = specs["blocks"]
+        assert b["qkv_w"] == P(None, None, "mp")
+        assert b["qkv_b"] == P(None, "mp")
+        assert b["proj_w"] == P(None, "mp")     # row-parallel inferred
+        assert b["up_b"] == P(None, "mp")
+        assert b["down_w"] == P(None, "mp")     # row-parallel inferred
+        for name in ("ln1_g", "ln1_b", "ln2_g", "ln2_b"):
+            assert b[name] == P()
+
+    def test_indivisible_dim_stays_replicated(self):
+        """A propagated axis whose size doesn't divide the dim must drop to
+        replicated, not error (GSPMD couldn't honor it)."""
+        mesh = mesh_2x4()
+        params = {"w1": jnp.ones((16, 32)), "b1": jnp.zeros((32,)),
+                  "g": jnp.ones((2, 16))}
+        x = jnp.ones((8, 16))
+
+        def loss(params, x):
+            h = jnp.tanh(x @ params["w1"] + params["b1"])
+            # reshape splits the mp-sharded 32-dim into (2, 16): mp(4)
+            # propagates onto the size-2 major factor, which 4 can't divide
+            z = h.reshape(8, 2, 16) * params["g"]
+            return (z.astype(jnp.float32) ** 2).mean()
+
+        specs = complete(loss, (params, x), {"*w1": P(None, "mp")}, mesh)
+        assert specs[0]["w1"] == P(None, "mp")
+        assert specs[0]["g"] == P()     # 2 % 4 != 0 → dropped, not error
+
+    def test_annotation_errors(self):
+        mesh = mesh_2x4()
+        params = mlp_params()
+        x = jnp.ones((8, 16))
+        with pytest.raises(ValueError, match="matches no input"):
+            complete(mlp_loss, (params, x), {"*nope": P("mp")}, mesh)
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            complete(mlp_loss, (params, x), {"*w1": P(None, "tp")}, mesh)
+        with pytest.raises(ValueError, match="not divisible"):
+            # 16 % 3 — no axis of size 3; use dp(2) on the 15-col weight
+            complete(mlp_loss,
+                     ({"w1": jnp.ones((16, 33)), "b1": jnp.zeros((33,)),
+                       "w2": jnp.ones((33, 16)), "b2": jnp.zeros((16,))},
+                      x),
+                     {"*w1": P(None, "mp")}, mesh)
+        with pytest.raises(ValueError, match="conflicting"):
+            complete(mlp_loss, (params, x),
+                     {"*w1": P(None, "mp"), "*b1": P("dp")}, mesh)
+
+
+class TestParity:
+    """Sharded-by-completed-specs training == single-device training."""
+
+    def _sgd_step(self, loss_fn, lr=0.1):
+        def step(params, x):
+            l, g = jax.value_and_grad(loss_fn)(params, x)
+            return jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                          params, g), l
+        return step
+
+    def test_mlp_train_parity(self):
+        mesh = mesh_2x4()
+        params = mlp_params()
+        x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        step = self._sgd_step(mlp_loss)
+
+        ref_p = jax.tree_util.tree_map(jnp.copy, params)
+        ref_step = jax.jit(step)
+
+        jstep, specs = parallelize(step, mesh, (params, jnp.asarray(x)),
+                                   {"*w1": P(None, "mp"), "1": P("dp")},
+                                   return_specs=True)
+        sp = reshard(params, specs[0], mesh)
+
+        for i in range(5):
+            xb = jnp.asarray(x + i)
+            ref_p, ref_l = ref_step(ref_p, xb)
+            sp, l = jstep(sp, xb)
+            np.testing.assert_allclose(np.asarray(l), np.asarray(ref_l),
+                                       rtol=2e-5, atol=2e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(sp),
+                        jax.tree_util.tree_leaves(ref_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_gpt_train_parity_three_annotations(self):
+        """The VERDICT acceptance bar: a GPT train step reaches parity loss
+        with ≤3 user annotations on the 8-device mesh."""
+        mesh = mesh_2x4()
+        cfg = dataclasses.replace(GPT_CONFIGS["tiny"], use_flash=False,
+                                  dtype="float32")
+        params = gpt_init(cfg, dtype=jnp.float32)
+        rng = np.random.default_rng(1)
+
+        def loss(params, tokens):
+            logits = gpt_forward(cfg, params, tokens)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tgt = jnp.roll(tokens, -1, 1)
+            return -jnp.take_along_axis(logp, tgt[..., None], -1).mean()
+
+        step = self._sgd_step(loss, lr=0.01)
+        toks0 = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)),
+                            jnp.int32)
+
+        jstep, specs = parallelize(
+            step, mesh, (params, toks0),
+            {"0/blocks/qkv_w": P(None, None, "mp"),
+             "0/blocks/up_w": P(None, None, "mp"),
+             "1": P("dp")}, return_specs=True)
+
+        ref_step = jax.jit(step)
+        ref_p = jax.tree_util.tree_map(jnp.copy, params)
+        sp = reshard(params, specs[0], mesh)
+        for _ in range(3):
+            toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)),
+                               jnp.int32)
+            ref_p, ref_l = ref_step(ref_p, toks)
+            sp, l = jstep(sp, toks)
+            np.testing.assert_allclose(np.asarray(l), np.asarray(ref_l),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestReshard:
+    def test_shard_tensor_roundtrip(self):
+        mesh = mesh_2x4()
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        sx = shard_tensor(jnp.asarray(x), mesh, P("dp", "mp"))
+        assert sx.sharding == NamedSharding(mesh, P("dp", "mp"))
+        np.testing.assert_array_equal(np.asarray(sx), x)
+
+    def test_reshard_between_layouts_and_meshes(self):
+        """Resharder analog: values survive arbitrary layout moves,
+        including onto a differently-factored mesh (reshard.py:603's
+        cross-mesh case)."""
+        mesh_a = mesh_2x4()
+        mesh_b = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                      ("x", "y"))
+        tree = {"w": jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                "b": jnp.arange(8, dtype=jnp.float32)}
+        on_a = reshard(tree, {"w": P("dp", "mp"), "b": P("mp")}, mesh_a)
+        on_b = reshard(on_a, {"w": P("y", "x"), "b": P(None)}, mesh_b)
+        assert on_b["w"].sharding == NamedSharding(mesh_b, P("y", "x"))
+        np.testing.assert_array_equal(np.asarray(on_b["w"]),
+                                      np.asarray(tree["w"]))
+        np.testing.assert_array_equal(np.asarray(on_b["b"]),
+                                      np.asarray(tree["b"]))
+
+    def test_reshard_single_spec_broadcast(self):
+        mesh = mesh_2x4()
+        tree = [jnp.ones((8, 4)), jnp.ones((16, 8))]
+        out = reshard(tree, P("dp"), mesh)
+        for leaf in out:
+            assert leaf.sharding == NamedSharding(mesh, P("dp"))

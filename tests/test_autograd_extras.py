@@ -228,3 +228,68 @@ class TestPyLayer:
         (gx,) = paddle.grad(y, [x], create_graph=True)
         (ggx,) = paddle.grad(gx.sum(), [x])
         np.testing.assert_allclose(np.asarray(ggx.data), [12.0])
+
+
+class TestMultiRootBackward:
+    def test_shared_subgraph_joint_walk(self):
+        """backward([r1, r2]) with a shared intermediate must run ONE
+        joint walk — a per-root loop frees h's node after the first
+        root and errors on the second (regression: code-review r4)."""
+        from paddle_tpu.autograd import backward
+
+        x = _t([1.0, 2.0])
+        h = x * 2.0
+        r1 = (h * h).sum()
+        r2 = (h * 3.0).sum()
+        backward([r1, r2])
+        # d r1/dx = 8x ; d r2/dx = 6
+        np.testing.assert_allclose(np.asarray(x.grad.data), [14.0, 22.0])
+
+    def test_length_mismatch_raises(self):
+        from paddle_tpu.autograd import backward
+
+        x = _t([1.0])
+        with pytest.raises(ValueError, match="lengths must match"):
+            backward([(x * 2.0).sum(), (x * 3.0).sum()],
+                     grad_tensors=[_t([1.0], False)])
+
+    def test_duplicate_roots_accumulate(self):
+        from paddle_tpu.autograd import backward
+
+        x = _t([2.0])
+        y = (x * x).sum()
+        backward([y, y])
+        np.testing.assert_allclose(np.asarray(x.grad.data), [8.0])
+
+
+class TestPartialGradPruning:
+    def test_side_branch_not_differentiated(self):
+        """grad(out, [mid]) must prune to the outputs→inputs subgraph
+        (PartialGradEngine parity): the deep branch below mid is not
+        walked, so its nodes survive for a later backward even with
+        retain_graph=False."""
+        from paddle_tpu.core.autograd import grad as fgrad
+
+        x = _t([1.0, 2.0])
+        mid = x * 3.0
+        out = (mid * mid).sum()
+        (g,) = fgrad(out, [mid])                 # retain_graph=False
+        np.testing.assert_allclose(np.asarray(g.data), [6.0, 12.0])
+        # the x*3 node was off the out→mid path: still differentiable
+        mid2 = mid.sum()
+        mid2.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.data), [3.0, 3.0])
+
+    def test_pruned_grad_still_exact_with_fanout(self):
+        """A consumer feeding a needed producer is itself needed: both
+        consumers of h contribute to grad wrt x."""
+        from paddle_tpu.core.autograd import grad as fgrad
+
+        x = _t([1.0, 3.0])
+        h = x * x
+        a = (h * 2.0).sum()
+        b = (h * 5.0).sum()
+        out = a + b
+        (g,) = fgrad(out, [x])
+        np.testing.assert_allclose(np.asarray(g.data),
+                                   14.0 * np.array([1.0, 3.0]))
