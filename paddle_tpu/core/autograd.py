@@ -161,11 +161,13 @@ def _walk(seeds, retain_graph, apply_vjp, zeros, add, input_ids=None):
     # of another seed, so its cotangent is only final when its producer
     # node is reached in the walk (leaf seeds fire in the end loop)
 
+    visited = set()
     for node in reversed(topo):
         if needed is not None and not needed[id(node)]:
             # off the outputs→inputs paths: contributes nothing to the
             # targets; left unreleased like any other unvisited node
             continue
+        visited.add(id(node))
         cts_in = []
         has_any = False
         for ref in node.out_refs:
@@ -203,9 +205,12 @@ def _walk(seeds, retain_graph, apply_vjp, zeros, add, input_ids=None):
         if not retain_graph:
             node.release()
 
-    # leaves never pass through the node loop: fire their hooks now
+    # tensors whose producer never ran (true leaves, and — under partial
+    # grad — targets whose producer was pruned) still have a finalized
+    # cotangent: fire their hooks now
     for tid, t in keepalive.items():
-        if t._node is None and t._grad_hooks and tid not in hooked:
+        if (t._grad_hooks and tid not in hooked
+                and (t._node is None or id(t._node) not in visited)):
             cotangents[tid] = _apply_hooks(t, cotangents[tid])
             hooked.add(tid)
     return {tid: (t, cotangents[tid]) for tid, t in keepalive.items()}

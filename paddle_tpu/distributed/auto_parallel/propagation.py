@@ -278,12 +278,14 @@ class _LinkBuilder:
         idx_dims = [d for d in range(len(_aval(indices).shape) - 1)]
         for od, idim in zip(batch_out, idx_dims):
             self.link(indices, idim, ov, od)
-        # full-slice operand dims ↔ the offset dims, in order
-        full = [d for d in range(len(oshape))
-                if d not in dn.collapsed_slice_dims
-                and slice_sizes[d] == oshape[d]]
-        for opd, od in zip(full, offset_dims):
-            self.link(operand, opd, ov, od)
+        # offset_dims[k] is the k-th NON-collapsed operand dim; pair
+        # first, then keep only full-slice dims (a partial slice breaks
+        # the shard-for-shard correspondence)
+        non_collapsed = [d for d in range(len(oshape))
+                         if d not in dn.collapsed_slice_dims]
+        for opd, od in zip(non_collapsed, offset_dims):
+            if slice_sizes[opd] == oshape[opd]:
+                self.link(operand, opd, ov, od)
 
     # ---- structured control flow: recurse, aligning boundaries ---------
     def _inner(self, sub):
@@ -395,14 +397,16 @@ class ShardingPropagator:
         paths = [_path_str(p) for p, _ in leaves_p]
         leaves = [l for _, l in leaves_p]
         invars = closed.jaxpr.invars
-        assert len(invars) == len(leaves), \
-            f"flattened args ({len(leaves)}) != jaxpr invars ({len(invars)})"
+        if len(invars) != len(leaves):
+            raise ValueError(
+                f"flattened args ({len(leaves)}) != jaxpr invars "
+                f"({len(invars)}) — fn must take exactly the given "
+                f"positional pytrees")
 
         uf = _UnionFind()
         _LinkBuilder(uf).walk(closed.jaxpr)
 
         # seed axes from annotations
-        matched = set()
         class_axis = {}          # root -> (axis_or_tuple, owner_path)
         for pat, spec in annotations.items():
             hits = [i for i, p in enumerate(paths)
@@ -411,7 +415,6 @@ class ShardingPropagator:
                 raise ValueError(
                     f"annotation {pat!r} matches no input; paths are like "
                     f"{paths[:5]}...")
-            matched.add(pat)
             for i in hits:
                 shape = np.shape(leaves[i])
                 entries = tuple(spec) + (None,) * (len(shape) - len(spec))

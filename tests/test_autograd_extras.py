@@ -293,3 +293,17 @@ class TestPartialGradPruning:
         (g,) = fgrad(out, [x])
         np.testing.assert_allclose(np.asarray(g.data),
                                    14.0 * np.array([1.0, 3.0]))
+
+    def test_hook_on_pruned_producer_target_still_fires(self):
+        """Hooks on a grad() target whose producer node is off the
+        outputs->inputs path must still see the finalized cotangent
+        (regression: pruning skipped the producer that used to fire
+        them)."""
+        from paddle_tpu.core.autograd import grad as fgrad
+
+        x = _t([1.0, 2.0])
+        mid = x * 3.0
+        mid.register_hook(lambda g: g * 10.0)
+        out = (mid * mid).sum()
+        (g,) = fgrad(out, [mid])
+        np.testing.assert_allclose(np.asarray(g.data), [60.0, 120.0])

@@ -67,6 +67,7 @@ class TestShardedRoundtrip:
         np.testing.assert_array_equal(np.asarray(tree["x"]), np.asarray(x))
 
 
+@pytest.mark.slow
 class TestEngineCheckpoint:
     def _uninterrupted(self):
         eng = HybridEngine(CFG, dp=2, mp=2, sharding=2)

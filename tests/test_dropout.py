@@ -64,6 +64,7 @@ class TestFunctionalDropout:
         assert abs(np.mean(ls) - l_eval) < 0.25
 
 
+@pytest.mark.slow
 class TestEngineDropout:
     def test_step_deterministic_per_seed(self):
         cfg = GPTConfig(**BASE, dropout=0.2)

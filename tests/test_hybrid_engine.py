@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy parity matrix (VERDICT r3 item 9)
+
 from paddle_tpu.distributed.engine import EngineConfig, HybridEngine
 from paddle_tpu.models.gpt import GPTConfig, gpt_loss
 

@@ -123,6 +123,7 @@ def _moe_params(rng, E, D, F):
     }
 
 
+@pytest.mark.slow
 class TestMoELayer:
     def test_matches_per_token_reference(self):
         """moe_layer output == per-token sum_e gate_e * FFN_e(x) when no
@@ -237,6 +238,7 @@ def moe_baseline():
     return _run_steps(eng)
 
 
+@pytest.mark.slow
 class TestEngineMoE:
     def test_single_device_loss_sane(self, moe_baseline):
         losses, _ = moe_baseline

@@ -82,6 +82,7 @@ class TestPipelineLayer:
         assert pl.run_funcs[0] is pl.run_funcs[2]
 
 
+@pytest.mark.slow
 class TestPipelineEngine:
     @pytest.fixture(scope="class")
     def pp1_losses(self):

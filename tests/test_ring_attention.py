@@ -94,6 +94,7 @@ class TestRingParity:
             )(q, k, v)
 
 
+@pytest.mark.slow
 class TestEngineRing:
     """sep=4 ring beats the Ulysses head cap: num_heads=2 < sep=4."""
 
