@@ -205,12 +205,16 @@ def _walk(seeds, retain_graph, apply_vjp, zeros, add, input_ids=None):
         if not retain_graph:
             node.release()
 
-    # tensors whose producer never ran (true leaves, and — under partial
-    # grad — targets whose producer was pruned) still have a finalized
-    # cotangent: fire their hooks now
+    # tensors whose producer never ran still hold a cotangent: fire hooks
+    # for true leaves, and — under partial grad — for pruned-producer
+    # TARGETS only (a non-target intermediate with a pruned producer has
+    # a PARTIAL cotangent: some consumers were skipped; firing its hooks
+    # would hand them a wrong gradient)
     for tid, t in keepalive.items():
-        if (t._grad_hooks and tid not in hooked
-                and (t._node is None or id(t._node) not in visited)):
+        if t._grad_hooks and tid not in hooked and (
+                t._node is None
+                or (id(t._node) not in visited
+                    and input_ids is not None and tid in input_ids)):
             cotangents[tid] = _apply_hooks(t, cotangents[tid])
             hooked.add(tid)
     return {tid: (t, cotangents[tid]) for tid, t in keepalive.items()}

@@ -270,6 +270,18 @@ class Tensor:
     def __bool__(self):
         if self.data.size != 1:
             raise ValueError("truth value of multi-element Tensor is ambiguous")
+        import jax
+
+        if isinstance(self.data, jax.core.Tracer):
+            # dy2static guard (reference: program_translator's AST pass
+            # rewrites `if tensor:`; we trace instead, so branching on a
+            # traced value must fail loudly with the supported alternative)
+            raise RuntimeError(
+                "Python control flow on a traced Tensor: under jit/"
+                "to_static the value is not concrete. Use "
+                "paddle_tpu.static.nn.cond / while_loop (or jax.lax.cond) "
+                "for tensor-dependent branches, or move the branch out of "
+                "the compiled function.")
         return bool(self.data)
 
     def __float__(self):

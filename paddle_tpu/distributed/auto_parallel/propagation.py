@@ -278,11 +278,18 @@ class _LinkBuilder:
         idx_dims = [d for d in range(len(_aval(indices).shape) - 1)]
         for od, idim in zip(batch_out, idx_dims):
             self.link(indices, idim, ov, od)
-        # offset_dims[k] is the k-th NON-collapsed operand dim; pair
-        # first, then keep only full-slice dims (a partial slice breaks
-        # the shard-for-shard correspondence)
+        # batched gathers (vmap-emitted): operand batching dims pair with
+        # indices batching dims shard-for-shard
+        ob = tuple(getattr(dn, "operand_batching_dims", ()) or ())
+        ib = tuple(getattr(dn, "start_indices_batching_dims", ()) or ())
+        for opd, idim in zip(ob, ib):
+            self.link(operand, opd, indices, idim)
+        # offset_dims[k] is the k-th operand dim that is neither collapsed
+        # nor a batching dim; pair first, then keep only full-slice dims
+        # (a partial slice breaks the shard-for-shard correspondence)
         non_collapsed = [d for d in range(len(oshape))
-                         if d not in dn.collapsed_slice_dims]
+                         if d not in dn.collapsed_slice_dims
+                         and d not in ob]
         for opd, od in zip(non_collapsed, offset_dims):
             if slice_sizes[opd] == oshape[opd]:
                 self.link(operand, opd, ov, od)

@@ -17,9 +17,12 @@ from . import passes
 from .passes import new_pass
 from .program import (Program, current_program, data, default_main_program,
                       program_guard)
+from .control_flow import cond, while_loop
+from . import nn
 
 __all__ = ["Program", "program_guard", "default_main_program", "data",
-           "Executor", "CompiledProgram", "new_pass", "passes"]
+           "Executor", "CompiledProgram", "new_pass", "passes",
+           "cond", "while_loop", "nn"]
 
 
 class Executor:

@@ -1,0 +1,212 @@
+"""Static control flow — cond / while_loop ops in the Program IR.
+
+Reference parity: paddle.static.nn.cond / while_loop over
+operators/controlflow/conditional_block_op.cc and while_op.cc (sub-block
+execution with scope-hierarchy variable lookup), built by
+fluid/layers/control_flow.py.
+
+TPU-native design: a branch/body is captured into a CHILD Program whose
+free variables (references to enclosing-block vids) and parameters become
+inputs of ONE parent-block op; that op's pure function lowers to
+``jax.lax.cond`` / ``jax.lax.while_loop`` over the child's replay.  The
+whole construct stays a single rewritable OpDesc for passes, and XLA
+compiles real device-side control flow — where the reference interprets
+sub-blocks with a second Executor on host.
+
+Both APIs also run EAGERLY (no program being captured): pred/cond are
+concrete, so Python control flow is the dygraph path, exactly the
+reference's dygraph fallback in layers.cond.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .program import Program, current_program, program_guard
+
+__all__ = ["cond", "while_loop"]
+
+
+def _as_tensor_list(out, what):
+    if out is None:
+        return []
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    for o in outs:
+        if not isinstance(o, Tensor):
+            raise TypeError(f"{what} must return Tensor(s), got "
+                            f"{type(o).__name__}")
+    return outs
+
+
+def _aval(t):
+    return (tuple(t.data.shape), str(t.data.dtype))
+
+
+class _Block:
+    """One captured sub-block: child Program + out vids + free/param lists."""
+
+    def __init__(self, fn, parent, placeholders=()):
+        self.sub = Program(parent=parent)
+        phs, self.ph_vids = [], []
+        for t in placeholders:
+            ph, vid = self.sub.add_local_like(t)
+            phs.append(ph)
+            self.ph_vids.append(vid)
+        with program_guard(self.sub):
+            outs = _as_tensor_list(fn(*phs), getattr(fn, "__name__", "block"))
+        self.outs = outs
+        self.out_vids = []
+        for o in outs:
+            vid = self.sub.lookup(o)
+            if vid is None:
+                # pass-through of an outer/placeholder tensor
+                vid = self.sub.lookup_chain(o)
+            if vid is None:
+                raise ValueError(
+                    "control-flow block returned a tensor that was not "
+                    "computed from its inputs or enclosing-block variables")
+            self.out_vids.append(vid)
+        # free outer vars discovered during capture; out pass-throughs of
+        # outer vars are in free_vars via the lookup_chain above
+        self.free = dict(self.sub.free_vars)       # vid -> Tensor
+        self.params = self.sub.param_refs()
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle.static.nn.cond parity.  Eagerly: Python if/else.  Under
+    program capture: ONE cond OpDesc lowering to jax.lax.cond."""
+    prog = current_program()
+    if prog is None:
+        taken = true_fn if bool(pred) else false_fn
+        return taken() if taken is not None else None
+
+    tb = _Block(true_fn, prog) if true_fn else _Block(lambda: [], prog)
+    fb = _Block(false_fn, prog) if false_fn else _Block(lambda: [], prog)
+    if len(tb.outs) != len(fb.outs):
+        raise ValueError(
+            f"cond branches must return the same number of tensors: "
+            f"true_fn returned {len(tb.outs)}, false_fn {len(fb.outs)}")
+    for i, (a, b) in enumerate(zip(tb.outs, fb.outs)):
+        if _aval(a) != _aval(b):
+            raise ValueError(
+                f"cond branch output {i} mismatch: true_fn "
+                f"{_aval(a)} vs false_fn {_aval(b)} — both branches must "
+                f"produce identical shapes/dtypes (XLA control flow is "
+                f"shape-static)")
+
+    free_vids = sorted(set(tb.free) | set(fb.free))
+    free_tensors = [tb.free[v] if v in tb.free else fb.free[v]
+                    for v in free_vids]
+    params, seen = [], set()
+    for p in tb.params + fb.params:
+        if id(p) not in seen:
+            seen.add(id(p))
+            params.append(p)
+    n_free = len(free_vids)
+    t_runner_vids, f_runner_vids = tb.out_vids, fb.out_vids
+    tb_sub, fb_sub = tb.sub, fb.sub
+    param_ids = [id(p) for p in params]
+
+    def pure_fn(pred_val, *vals):
+        free_env = dict(zip(free_vids, vals[:n_free]))
+        param_env = dict(zip(param_ids, vals[n_free:]))
+        p = jnp.asarray(pred_val).reshape(())
+
+        def t_run(_):
+            return tuple(tb_sub.replay_env(dict(free_env), t_runner_vids,
+                                           param_env))
+
+        def f_run(_):
+            return tuple(fb_sub.replay_env(dict(free_env), f_runner_vids,
+                                           param_env))
+
+        return jax.lax.cond(p, t_run, f_run, None)
+
+    # build-time eager value: the true branch's outputs are representative
+    # (both branches verified shape/dtype-identical above)
+    out_tensors = [Tensor(o.data) for o in tb.outs]
+    leaves, treedef = jax.tree_util.tree_flatten(
+        ((pred, *free_tensors, *params), {}),
+        is_leaf=lambda x: isinstance(x, Tensor))
+    prog.record("cond", pure_fn, treedef, leaves, out_tensors)
+    if not out_tensors:
+        return None
+    return out_tensors[0] if len(out_tensors) == 1 else out_tensors
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop parity.  Eagerly: a Python while.
+    Under capture: ONE while OpDesc lowering to jax.lax.while_loop
+    (body and condition each captured into a child Program with the
+    loop vars as block-local placeholders)."""
+    loop_vars = list(loop_vars)
+    for v in loop_vars:
+        if not isinstance(v, Tensor):
+            raise TypeError("while_loop loop_vars must be Tensors")
+    prog = current_program()
+    if prog is None:
+        vals = loop_vars
+        while bool(cond_fn(*vals)):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (tuple, list)) else [out]
+            if len(vals) != len(loop_vars):
+                raise ValueError(
+                    f"while_loop body returned {len(vals)} vars for "
+                    f"{len(loop_vars)} loop_vars")
+        return vals
+
+    cb = _Block(cond_fn, prog, placeholders=loop_vars)
+    bb = _Block(body_fn, prog, placeholders=loop_vars)
+    if len(cb.outs) != 1 or cb.outs[0].data.size != 1:
+        raise ValueError("while_loop condition must return one scalar "
+                         "boolean tensor")
+    if len(bb.outs) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body returned {len(bb.outs)} vars for "
+            f"{len(loop_vars)} loop_vars")
+    for i, (v, o) in enumerate(zip(loop_vars, bb.outs)):
+        if _aval(v) != _aval(o):
+            raise ValueError(
+                f"while_loop carry {i} changed signature: init {_aval(v)} "
+                f"vs body output {_aval(o)} — XLA loop carries are "
+                f"shape-static")
+
+    free_vids = sorted(set(cb.free) | set(bb.free))
+    free_tensors = [cb.free[v] if v in cb.free else bb.free[v]
+                    for v in free_vids]
+    params, seen = [], set()
+    for p in cb.params + bb.params:
+        if id(p) not in seen:
+            seen.add(id(p))
+            params.append(p)
+    n_loop, n_free = len(loop_vars), len(free_vids)
+    param_ids = [id(p) for p in params]
+    cb_sub, bb_sub = cb.sub, bb.sub
+    cb_ph, bb_ph = cb.ph_vids, bb.ph_vids
+    cb_out, bb_out = cb.out_vids, bb.out_vids
+
+    def pure_fn(*vals):
+        init = tuple(vals[:n_loop])
+        free_env = dict(zip(free_vids, vals[n_loop:n_loop + n_free]))
+        param_env = dict(zip(param_ids, vals[n_loop + n_free:]))
+
+        def c_run(carry):
+            env = dict(free_env)
+            env.update(zip(cb_ph, carry))
+            (res,) = cb_sub.replay_env(env, cb_out, param_env)
+            return jnp.asarray(res).reshape(())
+
+        def b_run(carry):
+            env = dict(free_env)
+            env.update(zip(bb_ph, carry))
+            return tuple(bb_sub.replay_env(env, bb_out, param_env))
+
+        return jax.lax.while_loop(c_run, b_run, init)
+
+    out_tensors = [Tensor(v.data) for v in loop_vars]
+    leaves, treedef = jax.tree_util.tree_flatten(
+        ((*loop_vars, *free_tensors, *params), {}),
+        is_leaf=lambda x: isinstance(x, Tensor))
+    prog.record("while", pure_fn, treedef, leaves, out_tensors)
+    return out_tensors

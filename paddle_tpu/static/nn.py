@@ -1,0 +1,50 @@
+"""paddle.static.nn namespace parity (control flow + static layer fns).
+
+The reference exposes cond/while_loop/case/switch_case under
+python/paddle/static/nn/control_flow.py; the layer builders (fc, conv2d,
+...) are the same nn.functional ops captured by program_guard, so they
+need no static-specific variants here.
+"""
+from __future__ import annotations
+
+from .control_flow import cond, while_loop
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Chained cond (reference static/nn/control_flow.py case): the first
+    true predicate's fn runs; lowered as nested cond ops.  With
+    ``default=None`` the LAST pair's fn is the default (reference
+    semantics — every path must produce the same outputs under XLA)."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case() needs at least one (pred, fn) pair")
+    if default is None:
+        _, default = pairs.pop()
+        if not pairs:
+            return default()
+
+    def build(rest):
+        (pred, fn), tail = rest[0], rest[1:]
+        if not tail:
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(tail))
+
+    return build(pairs)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer-indexed dispatch (reference switch_case), lowered via
+    nested cond on equality tests.  With ``default=None`` the fn of the
+    max index is the default (reference semantics)."""
+    from .. import ops
+
+    items = (sorted(branch_fns.items()) if isinstance(branch_fns, dict)
+             else list(enumerate(branch_fns)))
+    if default is None:
+        _, default = items.pop()          # max index (items sorted)
+        if not items:
+            return default()
+    pairs = [(ops.equal(branch_index, int(i)), fn) for i, fn in items]
+    return case(pairs, default=default)

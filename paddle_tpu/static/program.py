@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import weakref
 
 import numpy as np
 
@@ -27,6 +28,32 @@ __all__ = ["Program", "program_guard", "default_main_program",
            "current_program", "data", "OpDesc", "VarDesc"]
 
 _counter = itertools.count()
+
+# tensors defined inside a control-flow sub-block, so an enclosing block
+# can refuse them loudly instead of baking a stale trace-time value
+# (reference: scope hierarchy makes inner-block vars invisible outside).
+# Keyed by id() — Tensor.__eq__ is elementwise, so hash-based weak maps
+# would recurse into dispatch; a finalizer purges entries on GC.
+_block_owner: dict = {}
+
+
+def _register_block_tensor(t, prog):
+    tid = id(t)
+    _block_owner[tid] = (weakref.ref(t), prog)
+    weakref.finalize(t, _block_owner.pop, tid, None)
+
+
+def _owner_of(t):
+    entry = _block_owner.get(id(t))
+    if entry is not None and entry[0]() is t:
+        return entry[1]
+    return None
+
+
+def _root(p):
+    while p.parent is not None:
+        p = p.parent
+    return p
 
 
 class VarDesc:
@@ -83,13 +110,21 @@ class OpDesc:
 
 
 class Program:
-    """An ordered op list over named variables (ProgramDesc analog)."""
+    """An ordered op list over named variables (ProgramDesc analog).
 
-    def __init__(self):
+    ``parent`` links a control-flow sub-block to its enclosing program
+    (the reference's BlockDesc.parent_idx): vids are globally unique, so
+    a sub-block op may reference an outer variable directly — such free
+    variables are tracked in ``free_vars`` and become inputs of the
+    enclosing cond/while op (conditional_block_op's input list)."""
+
+    def __init__(self, parent=None):
+        self.parent = parent
         self.vars: dict[int, VarDesc] = {}
         self.ops: list[OpDesc] = []
         self._tensor_vids: dict[int, int] = {}   # id(Tensor) -> vid
         self._feed_names: dict[str, int] = {}
+        self.free_vars: dict[int, Tensor] = {}   # outer vid -> Tensor
         # strong refs to every tensor we keyed by id(): CPython reuses
         # addresses after GC, which would miswire lookup()
         self._keepalive: list = []
@@ -108,14 +143,50 @@ class Program:
         self._keepalive.append(t)
         return t
 
+    def add_local_like(self, tensor, name="ph"):
+        """A block-local placeholder (while-loop carry var)."""
+        vid = next(_counter)
+        self.vars[vid] = VarDesc(vid, f"{name}_{vid}", tensor.data.shape,
+                                 str(tensor.data.dtype))
+        t = Tensor(jnp.zeros_like(tensor.data))
+        self._tensor_vids[id(t)] = vid
+        self._keepalive.append(t)
+        if self.parent is not None:
+            _register_block_tensor(t, self)
+        return t, vid
+
     def lookup(self, tensor):
         return self._tensor_vids.get(id(tensor))
+
+    def lookup_chain(self, tensor):
+        """Resolve through enclosing blocks; marks outer hits as free."""
+        vid = self.lookup(tensor)
+        if vid is not None:
+            return vid
+        outer = self.parent
+        while outer is not None:
+            vid = outer.lookup(tensor)
+            if vid is not None:
+                self.free_vars[vid] = tensor
+                return vid
+            outer = outer.parent
+        return None
 
     def record(self, op_name, pure_fn, treedef, leaves, out_tensors):
         enc = []
         for leaf in leaves:
             if isinstance(leaf, Tensor):
-                vid = self.lookup(leaf)
+                vid = (self.lookup_chain(leaf) if self.parent is not None
+                       else self.lookup(leaf))
+                if vid is None:
+                    owner = _owner_of(leaf)
+                    if owner is not None and _root(owner) is _root(self):
+                        raise RuntimeError(
+                            "a tensor defined inside a control-flow "
+                            "sub-block (cond/while) was used outside it; "
+                            "inner-block variables are invisible to the "
+                            "enclosing block — return the value from the "
+                            "branch/body instead")
                 enc.append(_VarRef(vid) if vid is not None
                            else _ParamRef(leaf))
             else:
@@ -127,6 +198,8 @@ class Program:
                                      str(t.data.dtype))
             self._tensor_vids[id(t)] = vid
             self._keepalive.append(t)
+            if self.parent is not None:
+                _register_block_tensor(t, self)
             out_vids.append(vid)
         self.ops.append(OpDesc(op_name, pure_fn, treedef, enc, out_vids))
         self.version += 1
@@ -150,15 +223,23 @@ class Program:
         passed as traced inputs)."""
         values = {self._feed_names[k]: jnp.asarray(v)
                   for k, v in feed_arrays.items()}
-        pidx = ({id(t): i for i, t in enumerate(self.param_refs())}
-                if param_arrays is not None else None)
+        param_env = None
+        if param_arrays is not None:
+            param_env = {id(t): param_arrays[i]
+                         for i, t in enumerate(self.param_refs())}
+        return self.replay_env(values, fetch_vids, param_env)
+
+    def replay_env(self, values, fetch_vids, param_env=None):
+        """Replay over a prepopulated {vid: array} environment — also the
+        entry control-flow blocks use, seeded with their free/carry vars
+        (the reference's scope-hierarchy lookup in conditional_block)."""
 
         def resolve(leaf):
             if isinstance(leaf, _VarRef):
                 return values[leaf.vid]
             if isinstance(leaf, _ParamRef):
-                if pidx is not None:
-                    return param_arrays[pidx[id(leaf.tensor)]]
+                if param_env is not None and id(leaf.tensor) in param_env:
+                    return param_env[id(leaf.tensor)]
                 return leaf.tensor.data
             return leaf
 
@@ -184,7 +265,8 @@ class Program:
     __str__ = to_string
 
     def clone(self, for_test=False):
-        p = Program()
+        p = Program(parent=self.parent)
+        p.free_vars = dict(self.free_vars)
         p.vars = dict(self.vars)
         # deep-copy OpDescs: passes mutate pure_fn in place and must not
         # leak their rewrites into the original program

@@ -1,0 +1,243 @@
+"""Static control flow: cond / while_loop ops in the Program IR.
+
+Reference strategy: unittests/test_cond.py and test_while_loop.py run
+the same construct in dygraph and static mode and compare against a
+Python reference; conditional_block/while ops execute sub-blocks with
+scope-hierarchy lookup — here child Programs lowered onto
+jax.lax.cond/while_loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.core.tensor import Tensor
+
+
+def _run(program, feed, fetch):
+    exe = static.Executor()
+    return exe.run(program, feed=feed, fetch_list=fetch)
+
+
+class TestEager:
+    def test_cond_eager(self):
+        x = paddle.to_tensor(3.0)
+        out = static.cond(x > 2.0, lambda: x * 2.0, lambda: x - 1.0)
+        assert float(out.data) == 6.0
+        out = static.cond(x > 5.0, lambda: x * 2.0, lambda: x - 1.0)
+        assert float(out.data) == 2.0
+
+    def test_while_eager(self):
+        i = paddle.to_tensor(0.0)
+        s = paddle.to_tensor(1.0)
+        i, s = static.while_loop(lambda i, s: i < 4.0,
+                                 lambda i, s: (i + 1.0, s * 2.0), [i, s])
+        assert float(i.data) == 4.0 and float(s.data) == 16.0
+
+    def test_case_and_switch_eager(self):
+        x = paddle.to_tensor(1.0)
+        out = static.nn.case(
+            [(x > 2.0, lambda: x * 10.0), (x > 0.0, lambda: x + 1.0)],
+            default=lambda: x)
+        assert float(out.data) == 2.0
+
+
+class TestCapturedCond:
+    def test_cond_matches_eager_both_ways(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            pred = (x.sum() > 0.0)
+            out = static.cond(pred, lambda: x * 2.0, lambda: x - 1.0)
+        pos = np.ones(4, np.float32)
+        neg = -np.ones(4, np.float32)
+        (r_pos,) = _run(prog, {"x": pos}, [out])
+        (r_neg,) = _run(prog, {"x": neg}, [out])
+        np.testing.assert_allclose(r_pos, pos * 2.0)
+        np.testing.assert_allclose(r_neg, neg - 1.0)
+
+    def test_branch_mismatch_is_loud(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            with pytest.raises(ValueError, match="mismatch"):
+                static.cond(x.sum() > 0, lambda: x.reshape([2, 2]),
+                            lambda: x * 1.0)
+            with pytest.raises(ValueError, match="same number"):
+                static.cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+    def test_params_inside_branch_stay_live(self):
+        """A Layer parameter read inside a branch must see optimizer
+        updates between runs (scope semantics through the sub-block)."""
+        import paddle_tpu.nn as nn
+
+        lin = nn.Linear(4, 4)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            out = static.cond(x.sum() > 0.0,
+                              lambda: lin(x).sum(),
+                              lambda: x.sum() * 0.0)
+        feed = {"x": np.ones(4, np.float32)}
+        (before,) = _run(prog, feed, [out])
+        with paddle.no_grad():
+            lin.weight.set_value(Tensor(lin.weight.data * 2.0))
+            lin.bias.set_value(Tensor(lin.bias.data * 2.0))
+        (after,) = _run(prog, feed, [out])
+        np.testing.assert_allclose(after, before * 2.0, rtol=1e-6)
+
+    def test_switch_case_captured(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            idx = static.data("i", [], "int32")
+            x = static.data("x", [3], "float32")
+            out = static.nn.switch_case(
+                idx, {0: lambda: x + 1.0, 1: lambda: x * 10.0},
+                default=lambda: x * 0.0)
+        xs = np.array([1.0, 2.0, 3.0], np.float32)
+        (r0,) = _run(prog, {"i": np.int32(0), "x": xs}, [out])
+        (r1,) = _run(prog, {"i": np.int32(1), "x": xs}, [out])
+        (r9,) = _run(prog, {"i": np.int32(9), "x": xs}, [out])
+        np.testing.assert_allclose(r0, xs + 1.0)
+        np.testing.assert_allclose(r1, xs * 10.0)
+        np.testing.assert_allclose(r9, xs * 0.0)
+
+
+class TestCapturedWhile:
+    def test_while_matches_eager(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [], "float32")
+            i0 = paddle.to_tensor(0.0)
+            i, acc = static.while_loop(
+                lambda i, acc: i < x,          # x is a free outer var
+                lambda i, acc: (i + 1.0, acc + i),
+                [i0, paddle.to_tensor(0.0)])
+        (r,) = _run(prog, {"x": np.float32(5.0)}, [acc])
+        assert float(r) == 0 + 1 + 2 + 3 + 4
+
+    def test_loop_until_converged_model(self):
+        """The VERDICT acceptance bar: a loop-until-converged model
+        compiles (data-dependent trip count under jit) and matches the
+        eager Python loop.  Newton iteration for sqrt(a)."""
+        def newton_sqrt_eager(a, tol):
+            x = a / 2.0
+            while abs(x * x - a) > tol:
+                x = 0.5 * (x + a / x)
+            return x
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            a = static.data("a", [], "float32")
+            tol = static.data("tol", [], "float32")
+            (x,) = static.while_loop(
+                lambda x: (x * x - a).abs() > tol,
+                lambda x: (0.5 * (x + a / x),),
+                [a / 2.0])
+        for val in (9.0, 2.0, 100.0):
+            (r,) = _run(prog, {"a": np.float32(val),
+                               "tol": np.float32(1e-4)}, [x])
+            expect = newton_sqrt_eager(val, 1e-4)
+            np.testing.assert_allclose(r, expect, rtol=1e-5)
+            np.testing.assert_allclose(r, np.sqrt(val), rtol=1e-3)
+
+    def test_carry_signature_change_is_loud(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            with pytest.raises(ValueError, match="shape-static"):
+                static.while_loop(lambda v: v.sum() < 10.0,
+                                  lambda v: (v.reshape([2, 2]),), [x])
+
+    def test_nested_cond_in_while(self):
+        """Collatz step count — cond nested inside while, both captured."""
+        def collatz_eager(n):
+            steps = 0
+            while n != 1:
+                n = n // 2 if n % 2 == 0 else 3 * n + 1
+                steps += 1
+            return steps
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            n0 = static.data("n", [], "int32")
+            n, steps = static.while_loop(
+                lambda n, s: n != 1,
+                lambda n, s: (
+                    static.cond((n % 2) == 0,
+                                lambda: n // 2,
+                                lambda: 3 * n + 1),
+                    s + 1),
+                [n0, paddle.to_tensor(np.int32(0))])
+        for val in (6, 27):
+            (r,) = _run(prog, {"n": np.int32(val)}, [steps])
+            assert int(r) == collatz_eager(val)
+
+
+class TestTraceGuard:
+    def test_branch_on_traced_tensor_is_loud(self):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def f(x):
+            if x.sum() > 0:          # Python branch on a traced value
+                return x * 2.0
+            return x
+
+        with pytest.raises(Exception, match="cond"):
+            f(paddle.to_tensor(np.ones(4, np.float32)))
+
+
+class TestReviewRegressions:
+    def test_case_without_default_under_capture(self):
+        """case(default=None) uses the LAST pair's fn as the default
+        (reference semantics) instead of erroring on an empty branch."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            out = static.nn.case([(x.sum() > 0.0, lambda: x * 2.0),
+                                  (x.sum() <= 0.0, lambda: x - 1.0)])
+        pos = np.ones(4, np.float32)
+        neg = -np.ones(4, np.float32)
+        (r_pos,) = _run(prog, {"x": pos}, [out])
+        (r_neg,) = _run(prog, {"x": neg}, [out])
+        np.testing.assert_allclose(r_pos, pos * 2.0)
+        np.testing.assert_allclose(r_neg, neg - 1.0)
+
+    def test_inner_block_tensor_escape_is_loud(self):
+        """Using a tensor computed inside a branch after the cond must
+        raise (scope rules), not silently bake a stale value."""
+        leak = []
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+
+            def tf():
+                h = x * 2.0
+                leak.append(h)
+                return h
+
+            static.cond(x.sum() > 0.0, tf, lambda: x * 1.0)
+            with pytest.raises(RuntimeError, match="sub-block"):
+                _ = leak[0] + 1.0
+
+
+class TestPartialGradHookGate:
+    def test_hook_on_nontarget_pruned_intermediate_does_not_fire(self):
+        """A hooked intermediate that is NOT a grad target and whose
+        producer got pruned holds only a PARTIAL cotangent — its hook
+        must not fire with that wrong value."""
+        import paddle_tpu as paddle
+        from paddle_tpu.core.autograd import grad as fgrad
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        w = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        w.stop_gradient = False
+        m = w * 2.0
+        fired = []
+        m.register_hook(lambda g: fired.append(np.asarray(g.data)))
+        out = (x * m).sum() + (m * m).sum()
+        (g,) = fgrad(out, [x])
+        np.testing.assert_allclose(np.asarray(g.data), [6.0, 8.0])  # = m
+        assert fired == []   # partial cotangent: hook must stay silent
