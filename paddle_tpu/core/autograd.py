@@ -206,15 +206,14 @@ def _walk(seeds, retain_graph, apply_vjp, zeros, add, input_ids=None):
             node.release()
 
     # tensors whose producer never ran still hold a cotangent: fire hooks
-    # for true leaves, and — under partial grad — for pruned-producer
-    # TARGETS only (a non-target intermediate with a pruned producer has
-    # a PARTIAL cotangent: some consumers were skipped; firing its hooks
-    # would hand them a wrong gradient)
+    # for them — but under partial grad only for TARGETS (any non-target
+    # tensor, leaf or intermediate, may hold a PARTIAL cotangent because
+    # a consumer off the outputs→inputs paths was pruned; firing its
+    # hooks would hand them a wrong gradient)
     for tid, t in keepalive.items():
-        if t._grad_hooks and tid not in hooked and (
-                t._node is None
-                or (id(t._node) not in visited
-                    and input_ids is not None and tid in input_ids)):
+        if (t._grad_hooks and tid not in hooked
+                and (input_ids is None or tid in input_ids)
+                and (t._node is None or id(t._node) not in visited)):
             cotangents[tid] = _apply_hooks(t, cotangents[tid])
             hooked.add(tid)
     return {tid: (t, cotangents[tid]) for tid, t in keepalive.items()}

@@ -24,3 +24,4 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .multiprocess import WorkerInfo, get_worker_info  # noqa: F401

@@ -1,12 +1,13 @@
 """DataLoader (parity: python/paddle/fluid/reader.py:273 DataLoader +
 fluid/dataloader/dataloader_iter.py:341 multiprocess iter).
 
-Design: worker *threads* (not processes) with a bounded prefetch queue.
-The producers run numpy/PIL code while the main thread feeds the device —
-on TPU the overlap that matters is host-compute vs device-step, and jax
-dispatch already makes device work async.  (The reference needs processes
-because of Python-heavy decode + CUDA contexts; start with threads, keep the
-API so a process pool can slot in.)
+Design: ``num_workers>0`` forks worker PROCESSES that build batches into
+POSIX shared memory (io/multiprocess.py) — Python-heavy decode/transform
+scales past the GIL exactly as the reference's multiprocess path does —
+with a one-batch device-put lookahead in the parent so host→device
+transfer overlaps the device step.  ``num_workers=0`` runs inline;
+``use_thread_workers=True`` keeps the old GIL-thread pool for datasets
+that can't fork (live handles, sockets).
 """
 from __future__ import annotations
 
@@ -22,6 +23,16 @@ from .sampler import BatchSampler
 __all__ = ["DataLoader", "default_collate_fn"]
 
 
+def _batch_leaf(arr):
+    """Tensor in the parent process; a numpy stub inside a forked worker
+    (workers must not touch jax — see io/multiprocess.py)."""
+    from .multiprocess import NumpyStub, get_worker_info
+
+    if get_worker_info() is not None:
+        return NumpyStub(arr)
+    return Tensor(arr)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
@@ -30,11 +41,11 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s.data) for s in batch]))
+        return _batch_leaf(np.stack([np.asarray(s.data) for s in batch]))
     arr = np.stack([np.asarray(s) for s in batch])
     if arr.dtype == np.float64:
         arr = arr.astype(np.float32)
-    return Tensor(arr)
+    return _batch_leaf(arr)
 
 
 class DataLoader:
@@ -42,11 +53,17 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 use_thread_workers=False, mp_context=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_thread_workers = use_thread_workers
+        self.mp_context = mp_context
         self.iterable_mode = isinstance(dataset, IterableDataset)
         if self.iterable_mode:
             self.batch_sampler = None
@@ -82,7 +99,27 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
-        yield from self._threaded_iter()
+        if self.use_thread_workers:
+            yield from self._threaded_iter()
+            return
+        from .multiprocess import MultiprocessIter
+
+        yield from self._device_prefetch(
+            iter(MultiprocessIter(self, timeout=self.timeout)))
+
+    @staticmethod
+    def _device_prefetch(gen):
+        """One-batch lookahead: batch N+1's host→device transfer (Tensor
+        construction device-puts, dispatch is async) overlaps the
+        consumer's step on batch N (reference: use_buffer_reader)."""
+        try:
+            ahead = next(gen)
+        except StopIteration:
+            return
+        for nxt in gen:
+            yield ahead
+            ahead = nxt
+        yield ahead
 
     def _threaded_iter(self):
         """Bounded-queue prefetch: worker threads pull batch indices, build

@@ -1,0 +1,310 @@
+"""Multiprocess DataLoader machinery (parity:
+fluid/dataloader/dataloader_iter.py:341 _DataLoaderIterMultiProcess +
+worker.py _worker_loop: worker processes, shared-memory tensors, ordered
+reassembly, error propagation, worker_info).
+
+TPU-native notes: workers are FORKED producers that run ONLY user dataset
+code (numpy/PIL/decode) — they must never touch jax: the child inherits
+the parent's TPU/PJRT client state without its service threads, so any
+device call in a worker would deadlock.  Batches travel as raw bytes in
+POSIX shared memory (multiprocessing.shared_memory), the reference's
+_array_to_share_memory_tensor path, dodging both pickle cost and the
+queue's 64KB pipe chunking; the parent re-wraps and device-puts, with a
+one-batch lookahead so host→device transfer of batch N+1 overlaps the
+step on batch N (async dispatch does the rest).
+"""
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing as mp
+import queue as pyqueue
+import traceback
+from multiprocessing import shared_memory
+
+import numpy as np
+
+__all__ = ["WorkerInfo", "get_worker_info", "MultiprocessIter"]
+
+_worker_info = None
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: object
+
+
+class NumpyStub:
+    """Worker-side stand-in for Tensor: forked workers must never touch
+    jax (a device-put would go through the inherited, thread-less PJRT
+    client), so collate builds these; the parent rebuilds real Tensors."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = np.asarray(data)
+
+
+def get_worker_info():
+    """Inside a worker: (id, num_workers, dataset); else None (parity:
+    paddle.io.get_worker_info) — the hook IterableDataset uses to shard
+    its stream across workers."""
+    return _worker_info
+
+
+# ------------------------------------------------------------ wire format
+
+
+def _pack_shm(arrays):
+    """Copy a list of numpy arrays into ONE shared-memory segment.
+    Returns (shm_name, metas); the segment is left open for the parent."""
+    total = sum(int(a.nbytes) for a in arrays)
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
+    metas, off = [], 0
+    for a in arrays:
+        # single copy straight into the segment (tobytes() would add a
+        # full extra copy per array per batch)
+        dst = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf,
+                         offset=off)
+        np.copyto(dst, a)
+        del dst                       # release buffer export before close
+        metas.append((str(a.dtype), a.shape, off))
+        off += int(a.nbytes)
+    name = shm.name
+    shm.close()
+    # ownership transfers to the parent (which unlinks after copying);
+    # deregister from THIS process's resource tracker or it warns about
+    # "leaked" segments at worker exit and double-unlinks
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    return name, metas
+
+
+def _unpack_shm(name, metas):
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        out = []
+        for dtype, shape, off in metas:
+            n = int(np.dtype(dtype).itemsize * int(np.prod(shape or (1,))))
+            a = np.frombuffer(bytes(shm.buf[off:off + n]),
+                              dtype=dtype).reshape(shape)
+            out.append(a)
+        return out
+    finally:
+        shm.close()
+        shm.unlink()
+
+
+def _flatten_batch(batch):
+    """Split a collated batch into (numpy leaves, rebuild closure)."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        batch, is_leaf=lambda x: isinstance(x, (Tensor, NumpyStub)))
+    arrays, kinds = [], []
+    for leaf in leaves:
+        if isinstance(leaf, (Tensor, NumpyStub)):
+            arrays.append(np.asarray(leaf.data))
+            kinds.append("tensor")
+        elif isinstance(leaf, (np.ndarray, np.generic)):
+            arrays.append(np.asarray(leaf))
+            kinds.append("array")
+        else:
+            arrays.append(np.asarray(leaf))
+            kinds.append("scalar")
+    return arrays, (treedef, kinds)
+
+
+def _rebuild_batch(arrays, spec):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    treedef, kinds = spec
+    leaves = []
+    for a, kind in zip(arrays, kinds):
+        if kind == "tensor":
+            leaves.append(Tensor(a))
+        elif kind == "scalar":
+            leaves.append(a.item() if a.shape == () else a)
+        else:
+            leaves.append(a)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------------ worker loop
+
+
+def _worker_loop(loader, worker_id, num_workers, index_q, result_q,
+                 use_shared_memory, worker_init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
+                              dataset=loader.dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if loader.iterable_mode:
+            # each worker owns every num_workers-th BATCH of its own
+            # stream; sample-level sharding is the dataset's job via
+            # get_worker_info() (reference worker.py semantics)
+            for i, batch in enumerate(loader._iter_batches()):
+                if i % num_workers != worker_id:
+                    continue
+                _emit(result_q, i, batch, use_shared_memory)
+            result_q.put(("done", worker_id, None, None))
+            return
+        while True:
+            job = index_q.get()
+            if job is None:
+                result_q.put(("done", worker_id, None, None))
+                return
+            i, indices = job
+            batch = loader.collate_fn(
+                [loader.dataset[j] for j in indices])
+            _emit(result_q, i, batch, use_shared_memory)
+    except KeyboardInterrupt:
+        pass
+    except BaseException:
+        result_q.put(("error", worker_id, traceback.format_exc(), None))
+
+
+def _emit(result_q, i, batch, use_shared_memory):
+    arrays, spec = _flatten_batch(batch)
+    if use_shared_memory:
+        name, metas = _pack_shm(arrays)
+        result_q.put(("shm", i, (name, metas), spec))
+    else:
+        result_q.put(("raw", i, arrays, spec))
+
+
+# ------------------------------------------------------------ parent iter
+
+
+class MultiprocessIter:
+    """Ordered multiprocess prefetch iterator over a DataLoader."""
+
+    def __init__(self, loader, timeout=0):
+        self.loader = loader
+        self.nw = loader.num_workers
+        self.timeout = timeout or None
+        # fork is the default (datasets need not pickle; workers run only
+        # numpy/user code, never jax); pass mp_context="forkserver" or
+        # "spawn" on the DataLoader when the dataset pickles and you want
+        # to avoid fork-with-threads entirely
+        ctx = mp.get_context(getattr(loader, "mp_context", None) or "fork")
+        self.result_q = ctx.Queue()
+        self.index_q = ctx.Queue() if not loader.iterable_mode else None
+        self._procs = []
+        self._n_batches = None
+        self._pending = None
+        if not loader.iterable_mode:
+            self._pending = list(enumerate(loader.batch_sampler))
+            self._n_batches = len(self._pending)
+        for w in range(self.nw):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader, w, self.nw, self.index_q, self.result_q,
+                      loader.use_shared_memory, loader.worker_init_fn),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=1.0)
+        self._procs = []
+        # drain undelivered results: their shm segments were deregistered
+        # from the workers' resource trackers (ownership had transferred
+        # to us), so unlink here or an early `break` leaks /dev/shm
+        try:
+            while True:
+                kind, _, payload, _spec = self.result_q.get_nowait()
+                if kind == "shm":
+                    name, _metas = payload
+                    try:
+                        seg = shared_memory.SharedMemory(name=name)
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+        except pyqueue.Empty:
+            pass
+
+    def _get(self):
+        try:
+            return self.result_q.get(timeout=self.timeout)
+        except pyqueue.Empty:
+            self._shutdown()
+            raise RuntimeError(
+                f"DataLoader worker timed out after {self.timeout}s")
+
+    def _decode(self, kind, payload, spec):
+        if kind == "shm":
+            name, metas = payload
+            return _rebuild_batch(_unpack_shm(name, metas), spec)
+        return _rebuild_batch(payload, spec)
+
+    def __iter__(self):
+        try:
+            if self.loader.iterable_mode:
+                yield from self._iter_unordered_streams()
+            else:
+                yield from self._iter_indexed()
+        finally:
+            self._shutdown()
+
+    def _raise_worker(self, wid, tb):
+        self._shutdown()
+        raise RuntimeError(f"DataLoader worker {wid} failed:\n{tb}")
+
+    def _iter_indexed(self):
+        # prefetch window: keep nw*prefetch_factor jobs in flight
+        window = self.nw * self.loader.prefetch_factor
+        submitted = 0
+        for _ in range(min(window, self._n_batches)):
+            self.index_q.put(self._pending[submitted])
+            submitted += 1
+        buffered, next_idx = {}, 0
+        while next_idx < self._n_batches:
+            while next_idx not in buffered:
+                kind, idx, payload, spec = self._get()
+                if kind == "error":
+                    self._raise_worker(idx, payload)
+                buffered[idx] = self._decode(kind, payload, spec)
+            yield buffered.pop(next_idx)
+            next_idx += 1
+            if submitted < self._n_batches:
+                self.index_q.put(self._pending[submitted])
+                submitted += 1
+        for _ in range(self.nw):
+            self.index_q.put(None)
+
+    def _iter_unordered_streams(self):
+        """Iterable datasets: workers tag each batch with its global
+        stream index; reassemble ascending so the order matches the
+        single-process iteration of the same (sharded) streams."""
+        buffered, next_idx, done = {}, 0, 0
+        while done < self.nw:
+            kind, idx, payload, spec = self._get()
+            if kind == "error":
+                self._raise_worker(idx, payload)
+            if kind == "done":
+                done += 1
+                continue
+            buffered[idx] = self._decode(kind, payload, spec)
+            while next_idx in buffered:
+                yield buffered.pop(next_idx)
+                next_idx += 1
+        while next_idx in buffered:
+            yield buffered.pop(next_idx)
+            next_idx += 1

@@ -73,11 +73,32 @@ class _Block:
         self.params = self.sub.param_refs()
 
 
+def _is_traced(t):
+    return isinstance(t, Tensor) and isinstance(t.data, jax.core.Tracer)
+
+
+def _lax_tree(fn):
+    """Run a branch fn, unwrapping Tensor outputs to arrays (for direct
+    lax lowering when already under a jax trace)."""
+    out = fn() if fn is not None else None
+    return jax.tree_util.tree_map(
+        lambda x: x.data if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None):
     """paddle.static.nn.cond parity.  Eagerly: Python if/else.  Under
-    program capture: ONE cond OpDesc lowering to jax.lax.cond."""
+    program capture: ONE cond OpDesc lowering to jax.lax.cond.  Under an
+    active jax trace (jit.to_static, no program_guard): lower straight
+    to lax.cond — the construct this error path tells users to reach for
+    must itself work there."""
     prog = current_program()
     if prog is None:
+        if _is_traced(pred):
+            out = jax.lax.cond(pred.data.reshape(()),
+                               lambda _: _lax_tree(true_fn),
+                               lambda _: _lax_tree(false_fn), None)
+            return jax.tree_util.tree_map(Tensor, out)
         taken = true_fn if bool(pred) else false_fn
         return taken() if taken is not None else None
 
@@ -146,6 +167,24 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             raise TypeError("while_loop loop_vars must be Tensors")
     prog = current_program()
     if prog is None:
+        if any(_is_traced(v) for v in loop_vars) or _is_traced(
+                cond_fn(*loop_vars)):
+            # under a jax trace (jit.to_static): lower directly
+            def c_run(carry):
+                r = cond_fn(*[Tensor(c) for c in carry])
+                return jnp.asarray(
+                    r.data if isinstance(r, Tensor) else r).reshape(())
+
+            def b_run(carry):
+                out = body_fn(*[Tensor(c) for c in carry])
+                outs = (list(out) if isinstance(out, (tuple, list))
+                        else [out])
+                return tuple(o.data if isinstance(o, Tensor) else o
+                             for o in outs)
+
+            final = jax.lax.while_loop(
+                c_run, b_run, tuple(v.data for v in loop_vars))
+            return [Tensor(f) for f in final]
         vals = loop_vars
         while bool(cond_fn(*vals)):
             out = body_fn(*vals)

@@ -39,14 +39,16 @@ _block_owner: dict = {}
 
 def _register_block_tensor(t, prog):
     tid = id(t)
-    _block_owner[tid] = (weakref.ref(t), prog)
+    # both refs weak: a strong Program ref here would keep the Program's
+    # _keepalive (and thus t) alive forever, so the finalizer never fires
+    _block_owner[tid] = (weakref.ref(t), weakref.ref(prog))
     weakref.finalize(t, _block_owner.pop, tid, None)
 
 
 def _owner_of(t):
     entry = _block_owner.get(id(t))
     if entry is not None and entry[0]() is t:
-        return entry[1]
+        return entry[1]()
     return None
 
 

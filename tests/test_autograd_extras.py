@@ -307,3 +307,18 @@ class TestPartialGradPruning:
         out = (mid * mid).sum()
         (g,) = fgrad(out, [mid])
         np.testing.assert_allclose(np.asarray(g.data), [60.0, 120.0])
+
+    def test_hook_on_nontarget_leaf_with_pruned_consumer_stays_silent(self):
+        """Same partial-cotangent hazard for LEAVES: a hooked non-target
+        leaf whose other consumer was pruned must not fire."""
+        import paddle_tpu as paddle
+        from paddle_tpu.core.autograd import grad as fgrad
+
+        x = _t([1.0, 2.0])
+        h = _t([3.0, 4.0])
+        fired = []
+        h.register_hook(lambda g: fired.append(np.asarray(g.data)))
+        out = (x * h).sum() + (h * h).sum()
+        (g,) = fgrad(out, [x])
+        np.testing.assert_allclose(np.asarray(g.data), [3.0, 4.0])
+        assert fired == []

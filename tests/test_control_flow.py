@@ -241,3 +241,25 @@ class TestPartialGradHookGate:
         (g,) = fgrad(out, [x])
         np.testing.assert_allclose(np.asarray(g.data), [6.0, 8.0])  # = m
         assert fired == []   # partial cotangent: hook must stay silent
+
+    def test_cond_and_while_work_under_to_static(self):
+        """The guard error tells users to reach for static.nn.cond /
+        while_loop — they must actually work inside jit.to_static (no
+        program_guard, live jax trace)."""
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def f(x):
+            doubled = static.cond(x.sum() > 0.0, lambda: x * 2.0,
+                                  lambda: x - 1.0)
+            (count,) = static.while_loop(
+                lambda c: c.sum() < 20.0, lambda c: (c + doubled.sum(),),
+                [doubled * 0.0])
+            return count
+
+        pos = paddle.to_tensor(np.ones(4, np.float32))
+        out = f(pos)
+        # doubled = 2s, sum 8; count grows by 8/elem until sum >= 20:
+        # 0 -> 8*4=32 per tick summed... count vec adds 8 each tick;
+        # sum(count) hits 32 after one tick < 20? 32 >= 20 -> one tick
+        np.testing.assert_allclose(np.asarray(out.data), np.full(4, 8.0))
