@@ -21,6 +21,9 @@ import jax.numpy as jnp
 from . import dtype as dtypes
 from .place import _current_place, Place
 
+# set True inside forked DataLoader worker processes (io/multiprocess.py)
+_IN_DATALOADER_WORKER = False
+
 __all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
 
 
@@ -30,6 +33,13 @@ class Tensor:
                  "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None, place=None):
+        if _IN_DATALOADER_WORKER:
+            # a device-put through the forked, thread-less PJRT client
+            # hangs; fail loudly instead (io/multiprocess.py sets this)
+            raise RuntimeError(
+                "Tensor construction inside a DataLoader worker process: "
+                "return numpy arrays from __getitem__/collate_fn (the "
+                "parent wraps them), or pass use_thread_workers=True.")
         if isinstance(data, Tensor):
             data = data.data
         if not isinstance(data, jax.Array):

@@ -15,16 +15,19 @@ AD transposes it into the reverse pipeline, giving 1F1B's work pattern
 with activation liveness bounded by per-tick remat instead of manual
 schedule bookkeeping).
 
-Trade-off (documented, deliberate): stage params are replicated across pp
-ranks — predicated dispatch needs every rank to hold every branch's
-operands.  For homogeneous transformer stacks use HybridEngine, whose
-stacked-block layout shards params over 'pp'; PipelineLayer is the
-API-parity path for arbitrary heterogeneous Layer lists (the reference's
-AlexNet-style pp tests).
+Stage params are SHARDED per pp rank (reference pp_layers.py:159 gives
+each rank only its stage's sublayers): predicated dispatch needs every
+rank to hold a uniform operand, so each stage's param leaves are packed
+into ONE flat fp32 vector, zero-padded to the widest stage, and stacked
+[pp, Pmax] with PartitionSpec("pp") — every rank holds exactly its own
+stage's 1/pp slice, and each lax.switch branch unflattens the LOCAL
+buffer by its own stage's (shape, dtype, offset) spec.  Layers shared
+across stages (tied embeddings, SharedLayerDesc) stay replicated; their
+grads psum over 'pp' on the AD transpose — the reference's
+allreduce_shared_weight_gradients (pipeline_parallel.py:148).
 """
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 
@@ -194,8 +197,84 @@ class PipelineEngine:
             if key not in seen:
                 seen[key] = len(seen)
             self._index.append(seen[key])
+        self._build_pack_specs()
         if sample_input is not None:
             self._infer_shapes(sample_input)
+
+    # ------------------------------------------------------ param packing
+    def _build_pack_specs(self):
+        """Assign each unique layer to the single stage that runs it (its
+        params live only on that rank) or to the replicated 'shared' set
+        when multiple stages touch it (tied weights)."""
+        stage_of = {}          # uidx -> set of stages
+        for pos, uidx in enumerate(self._index):
+            stage = next(s for s in range(self.pp)
+                         if self.pl._bounds[s] <= pos < self.pl._bounds[s + 1])
+            stage_of.setdefault(uidx, set()).add(stage)
+        self._shared_uidx = sorted(u for u, ss in stage_of.items()
+                                   if len(ss) > 1)
+        # per-stage flat layout: list of (uidx, name, shape, dtype, offset)
+        self._stage_specs = [[] for _ in range(self.pp)]
+        sizes = [0] * self.pp
+        uniq_layers = {}
+        for layer, uidx in zip(self.pl.run_funcs, self._index):
+            uniq_layers.setdefault(uidx, layer)
+        for uidx, stages in sorted(stage_of.items()):
+            if uidx in self._shared_uidx:
+                continue
+            (s,) = stages
+            params = uniq_layers[uidx].raw_state()[0]
+            for name in sorted(params):
+                arr = params[name]
+                n = int(np.prod(arr.shape)) if arr.shape else 1
+                self._stage_specs[s].append(
+                    (uidx, name, tuple(arr.shape), arr.dtype, sizes[s]))
+                sizes[s] += n
+        self._pmax = max(sizes) if any(sizes) else 1
+        self._stage_sizes = sizes
+
+    def _pack(self, logical):
+        """logical per-layer state -> {'flat': [pp, Pmax] fp32 (to shard
+        over 'pp'), 'shared': replicated dicts}."""
+        rows = []
+        for s in range(self.pp):
+            pieces = [jnp.asarray(logical[uidx][name], jnp.float32).reshape(-1)
+                      for (uidx, name, _sh, _dt, _off)
+                      in self._stage_specs[s]]
+            vec = (jnp.concatenate(pieces) if pieces
+                   else jnp.zeros((0,), jnp.float32))
+            rows.append(jnp.pad(vec, (0, self._pmax - vec.shape[0])))
+        shared = {str(u): {k: jnp.asarray(v) for k, v in logical[u].items()}
+                  for u in self._shared_uidx}
+        return {"flat": jnp.stack(rows), "shared": shared}
+
+    def unpack(self, packed):
+        """Packed -> logical per-layer state (host-side; gathers)."""
+        flat = np.asarray(packed["flat"])
+        # param-less layers keep {} so load_state(unpack(...)) round-trips
+        logical = [{} for _ in range(max(self._index) + 1)]
+        for s in range(self.pp):
+            for (uidx, name, shape, dtype, off) in self._stage_specs[s]:
+                n = int(np.prod(shape)) if shape else 1
+                arr = jnp.asarray(flat[s, off:off + n],
+                                  jnp.float32).reshape(shape).astype(dtype)
+                logical[uidx][name] = arr
+        for u in self._shared_uidx:
+            logical[u] = dict(packed["shared"][str(u)])
+        return logical
+
+    def _stage_state(self, stage, flat_row, shared):
+        """Rebuild stage-local {uidx: {name: arr}} from the LOCAL flat
+        buffer (each rank sees only its own stage's row)."""
+        st = {int(u): dict(shared[u]) for u in shared}
+        lo, hi = self.pl._bounds[stage], self.pl._bounds[stage + 1]
+        for li in range(lo, hi):
+            st.setdefault(self._index[li], {})   # param-less layers
+        for (uidx, name, shape, dtype, off) in self._stage_specs[stage]:
+            n = int(np.prod(shape)) if shape else 1
+            arr = flat_row[off:off + n].reshape(shape).astype(dtype)
+            st.setdefault(uidx, {})[name] = arr
+        return st
 
     # --------------------------------------------------------------- params
     def state(self):
@@ -254,7 +333,7 @@ class PipelineEngine:
             arr = t.data if isinstance(t, Tensor) else t
         return arr
 
-    def _local_step(self, state_list, x_all, labels, lr):
+    def _local_step(self, packed, x_all, labels, lr):
         pp, num_micro = self.pp, self.num_micro
         pp_idx = jax.lax.axis_index("pp")
         B = x_all.shape[0]
@@ -265,11 +344,12 @@ class PipelineEngine:
 
         lift = lifter("pp")
 
-        def loss_fn(state_list):
-            # every pp-invariant operand consumed inside cond/switch
-            # branches is lifted HERE so AD's de-varying psum over 'pp'
-            # lands outside the predicated region (all ranks execute it)
-            st = jax.tree_util.tree_map(lift, state_list)
+        def loss_fn(flat_row, shared):
+            # pp-invariant operands consumed inside cond/switch branches
+            # are lifted HERE so AD's de-varying psum over 'pp' lands
+            # outside the predicated region (all ranks execute it);
+            # flat_row is sharded over pp — already varying, grads local
+            shared_l = jax.tree_util.tree_map(lift, shared)
             x_mb = lift(x_all.reshape(num_micro, mb, *x_all.shape[1:])
                         .astype(jnp.float32))
             lab_mb = lift(labels.reshape(num_micro, mb, *labels.shape[1:]))
@@ -282,9 +362,13 @@ class PipelineEngine:
             for s in range(pp):
                 in_shape = self._shapes[s]
 
-                def br(st_, buf, s=s, in_shape=in_shape):
+                def br(buf, s=s, in_shape=in_shape):
                     a = buf[:, :int(np.prod(in_shape))].reshape(
                         (mb,) + in_shape)
+                    # each rank unflattens its OWN stage's slice of the
+                    # local param buffer — branch s only ever runs where
+                    # pp_idx == s, where flat_row IS stage s's params
+                    st_ = self._stage_state(s, flat_row, shared_l)
                     out = self._stage_apply(s, st_, a)
                     return pack(out)
 
@@ -297,9 +381,7 @@ class PipelineEngine:
                 is_live = (t >= pp_idx) & (t - pp_idx < num_micro)
                 y = jax.lax.cond(
                     is_live,
-                    lambda b: jax.lax.switch(
-                        pp_idx, [functools.partial(f, st) for f in branches],
-                        b),
+                    lambda b: jax.lax.switch(pp_idx, branches, b),
                     lambda b: b,
                     state)
                 m = t - (pp - 1)
@@ -329,23 +411,34 @@ class PipelineEngine:
             # mean over microbatches; psum over pp (only last stage added)
             return jax.lax.psum(loss_sum, "pp") / num_micro
 
-        loss, grads = jax.value_and_grad(loss_fn)(state_list)
-        # grads came out of loss_fn's lift-transpose already psum'd over pp
-        new_state = jax.tree_util.tree_map(
-            lambda p, g: (p - lr * g).astype(p.dtype), state_list, grads)
-        return new_state, loss
+        flat_row = packed["flat"][0]          # local [Pmax]: THIS stage
+        shared = packed["shared"]
+        loss, (g_flat, g_shared) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(flat_row, shared)
+        # g_flat is rank-local (sharded params: no cross-stage psum);
+        # g_shared came out of the lift transpose psum'd over pp —
+        # identical on every rank, so the update keeps them replicated
+        new_flat = (flat_row - lr * g_flat)[None, :]
+        new_shared = jax.tree_util.tree_map(
+            lambda p, g: (p - lr * g).astype(p.dtype), shared, g_shared)
+        return {"flat": new_flat, "shared": new_shared}, loss
 
     def build_step(self):
         if self._step_fn is None:
+            # spec pytree prefix: flat sharded over pp, shared replicated
+            sspec = {"flat": P("pp"), "shared": P()}
             mapped = jax.shard_map(
                 self._local_step, mesh=self.mesh,
-                in_specs=(P(), P(), P(), P()), out_specs=(P(), P()),
+                in_specs=(sspec, P(), P(), P()),
+                out_specs=(sspec, P()),
                 check_vma=True)
             self._step_fn = jax.jit(mapped)
         return self._step_fn
 
     def train_batch(self, data, labels, state=None, lr=None):
         """One pipeline-parallel SGD step; returns (new_state, loss).
+        ``state`` is the PACKED pytree from the previous step (or a
+        logical per-layer list / None to start from the live layers).
         Reference: PipelineParallel.train_batch (pipeline_parallel.py:153)."""
         if self.pl.loss_fn is None:
             raise ValueError("PipelineLayer needs loss_fn to train")
@@ -357,7 +450,9 @@ class PipelineEngine:
             # retrace for the new input shape re-reads them
             self._infer_shapes(data)
         if state is None:
-            state = self.state()
+            state = self._pack(self.state())
+        elif isinstance(state, list):
+            state = self._pack(state)
         fn = self.build_step()
         lr = jnp.asarray(lr if lr is not None else self.lr, jnp.float32)
         new_state, loss = fn(state, data, labels, lr)

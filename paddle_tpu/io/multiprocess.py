@@ -147,17 +147,21 @@ def _worker_loop(loader, worker_id, num_workers, index_q, result_q,
     global _worker_info
     _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
                               dataset=loader.dataset)
+    from ..core import tensor as _core_tensor
+
+    _core_tensor._IN_DATALOADER_WORKER = True
     try:
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
         if loader.iterable_mode:
-            # each worker owns every num_workers-th BATCH of its own
-            # stream; sample-level sharding is the dataset's job via
-            # get_worker_info() (reference worker.py semantics)
+            # reference/worker.py semantics: every worker iterates ITS
+            # OWN replica of the stream; sample-level sharding is the
+            # dataset's job via get_worker_info() (an unsharded dataset
+            # yields each sample num_workers times — same as the
+            # reference).  Batches are tagged (worker, local_idx) and
+            # the parent interleaves round-robin.
             for i, batch in enumerate(loader._iter_batches()):
-                if i % num_workers != worker_id:
-                    continue
-                _emit(result_q, i, batch, use_shared_memory)
+                _emit(result_q, (worker_id, i), batch, use_shared_memory)
             result_q.put(("done", worker_id, None, None))
             return
         while True:
@@ -199,7 +203,11 @@ class MultiprocessIter:
         # "spawn" on the DataLoader when the dataset pickles and you want
         # to avoid fork-with-threads entirely
         ctx = mp.get_context(getattr(loader, "mp_context", None) or "fork")
-        self.result_q = ctx.Queue()
+        # bounded: backpressure for iterable streams (each queued shm
+        # batch is live tmpfs memory) — map mode's in-flight work is
+        # window-bounded anyway; +nw leaves room for the "done" marks
+        self.result_q = ctx.Queue(
+            maxsize=self.nw * loader.prefetch_factor + self.nw)
         self.index_q = ctx.Queue() if not loader.iterable_mode else None
         self._procs = []
         self._n_batches = None
@@ -241,12 +249,32 @@ class MultiprocessIter:
             pass
 
     def _get(self):
-        try:
-            return self.result_q.get(timeout=self.timeout)
-        except pyqueue.Empty:
-            self._shutdown()
-            raise RuntimeError(
-                f"DataLoader worker timed out after {self.timeout}s")
+        """Pop a result; poll worker liveness so a SIGKILLed/segfaulted
+        worker (which can't enqueue an error) raises instead of hanging
+        the training loop forever."""
+        waited = 0.0
+        poll = 2.0
+        while True:
+            try:
+                return self.result_q.get(
+                    timeout=poll if self.timeout is None
+                    else min(poll, self.timeout - waited))
+            except pyqueue.Empty:
+                waited += poll
+                dead = [p for p in self._procs
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                if dead:
+                    codes = [p.exitcode for p in dead]
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) died unexpectedly "
+                        f"(exitcode(s) {codes}) — killed by the OS "
+                        f"(OOM?) or a native crash")
+                if self.timeout is not None and waited >= self.timeout:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after "
+                        f"{self.timeout}s")
 
     def _decode(self, kind, payload, spec):
         if kind == "shm":
@@ -290,21 +318,35 @@ class MultiprocessIter:
             self.index_q.put(None)
 
     def _iter_unordered_streams(self):
-        """Iterable datasets: workers tag each batch with its global
-        stream index; reassemble ascending so the order matches the
-        single-process iteration of the same (sharded) streams."""
-        buffered, next_idx, done = {}, 0, 0
-        while done < self.nw:
+        """Iterable datasets: batches arrive tagged (worker, local_idx);
+        yield round-robin across workers (w0:b0, w1:b0, ..., w0:b1, ...)
+        — the reference's deterministic interleave — dropping finished
+        workers from the rotation."""
+        buffered = {}                     # (worker, local_idx) -> batch
+        finished = [False] * self.nw
+        counts = [0] * self.nw            # batches received per worker
+        local = [0] * self.nw             # next local index to yield
+        w = 0
+
+        def exhausted(i):
+            return finished[i] and local[i] >= counts[i]
+
+        while not all(exhausted(i) for i in range(self.nw)):
+            if exhausted(w):
+                w = (w + 1) % self.nw
+                continue
+            key = (w, local[w])
+            if key in buffered:
+                yield buffered.pop(key)
+                local[w] += 1
+                w = (w + 1) % self.nw
+                continue
             kind, idx, payload, spec = self._get()
             if kind == "error":
                 self._raise_worker(idx, payload)
-            if kind == "done":
-                done += 1
-                continue
-            buffered[idx] = self._decode(kind, payload, spec)
-            while next_idx in buffered:
-                yield buffered.pop(next_idx)
-                next_idx += 1
-        while next_idx in buffered:
-            yield buffered.pop(next_idx)
-            next_idx += 1
+            elif kind == "done":
+                finished[idx] = True
+            else:
+                wid, li = idx
+                counts[wid] += 1
+                buffered[(wid, li)] = self._decode(kind, payload, spec)

@@ -167,8 +167,8 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             raise TypeError("while_loop loop_vars must be Tensors")
     prog = current_program()
     if prog is None:
-        if any(_is_traced(v) for v in loop_vars) or _is_traced(
-                cond_fn(*loop_vars)):
+        probe = cond_fn(*loop_vars)       # reused as the first loop test
+        if any(_is_traced(v) for v in loop_vars) or _is_traced(probe):
             # under a jax trace (jit.to_static): lower directly
             def c_run(carry):
                 r = cond_fn(*[Tensor(c) for c in carry])
@@ -186,13 +186,15 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
                 c_run, b_run, tuple(v.data for v in loop_vars))
             return [Tensor(f) for f in final]
         vals = loop_vars
-        while bool(cond_fn(*vals)):
+        cont = probe
+        while bool(cont):
             out = body_fn(*vals)
             vals = list(out) if isinstance(out, (tuple, list)) else [out]
             if len(vals) != len(loop_vars):
                 raise ValueError(
                     f"while_loop body returned {len(vals)} vars for "
                     f"{len(loop_vars)} loop_vars")
+            cont = cond_fn(*vals)
         return vals
 
     cb = _Block(cond_fn, prog, placeholders=loop_vars)

@@ -34,7 +34,9 @@ class FailingDataset(SquareDataset):
 
 
 class CountStream(IterableDataset):
-    """Iterable dataset sharded across workers via get_worker_info."""
+    """Iterable dataset sharded across workers via get_worker_info
+    (reference worker.py semantics: each worker iterates its own
+    replica; unsharded streams duplicate num_workers times)."""
 
     def __init__(self, n=24):
         self.n = n
@@ -44,11 +46,8 @@ class CountStream(IterableDataset):
         wid = info.id if info else 0
         nw = info.num_workers if info else 1
         for i in range(self.n):
-            # sample-level sharding: each worker emits its own slice,
-            # but batch-level round-robin in the loader keeps only this
-            # worker's batches — emit ALL so order is reconstructible
-            yield np.full((4,), float(i), np.float32)
-        del wid, nw
+            if i % nw == wid:
+                yield np.full((4,), float(i), np.float32)
 
 
 def _collect(loader):
@@ -96,13 +95,26 @@ class TestMultiprocess:
         out = _collect(loader)
         assert len(out) == 4
 
-    def test_iterable_dataset_round_robin(self):
-        ds = CountStream(24)
-        ref = _collect(DataLoader(ds, batch_size=4, num_workers=0))
-        got = _collect(DataLoader(ds, batch_size=4, num_workers=3))
-        assert len(got) == len(ref)
-        for a, b in zip(got, ref):
-            np.testing.assert_array_equal(a, b)
+    def test_iterable_sharded_covers_dataset_once(self):
+        """A worker_info-sharded stream: every sample exactly once across
+        the interleaved worker streams (no double-sharding)."""
+        got = _collect(DataLoader(CountStream(24), batch_size=4,
+                                  num_workers=3))
+        seen = sorted(v for b in got for v in b[:, 0])
+        assert seen == [float(i) for i in range(24)]
+
+    def test_iterable_unsharded_duplicates_like_reference(self):
+        """An UNsharded iterable stream is replicated per worker (the
+        documented reference semantics) — each sample appears
+        num_workers times."""
+        class Plain(IterableDataset):
+            def __iter__(self):
+                for i in range(8):
+                    yield np.full((2,), float(i), np.float32)
+
+        got = _collect(DataLoader(Plain(), batch_size=4, num_workers=2))
+        seen = sorted(v for b in got for v in b[:, 0])
+        assert seen == sorted([float(i) for i in range(8)] * 2)
 
     def test_gil_heavy_transform_scales(self):
         """Smoke (not a timing assert): a CPU-burning transform completes
@@ -146,3 +158,38 @@ class TestEarlyAbandon:
         time.sleep(0.3)
         after = set(glob.glob("/dev/shm/psm_*"))
         assert after - before == set(), f"leaked: {after - before}"
+
+
+class TestWorkerSafety:
+    def test_tensor_in_worker_is_loud_not_deadlocked(self):
+        """Dataset code constructing a Tensor inside a forked worker must
+        raise the directed error (a device-put would hang forever)."""
+        class TensorDataset(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return paddle.to_tensor(np.ones(4, np.float32) * i)
+
+        loader = DataLoader(TensorDataset(), batch_size=2, num_workers=2,
+                            timeout=30)
+        with pytest.raises(RuntimeError,
+                           match="Tensor construction inside a DataLoader"):
+            _collect(loader)
+
+    def test_sigkilled_worker_raises_not_hangs(self):
+        """A worker killed by the OS (no error message possible) must
+        surface as RuntimeError via the liveness poll, not hang."""
+        class Killer(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                if i == 5:
+                    os._exit(137)      # simulates SIGKILL/OOM
+                time.sleep(0.01)
+                return np.ones(2, np.float32)
+
+        loader = DataLoader(Killer(), batch_size=4, num_workers=2)
+        with pytest.raises(RuntimeError, match="died unexpectedly"):
+            _collect(loader)

@@ -128,3 +128,77 @@ class TestPipelineEngine:
             state, loss = eng.train_batch(x, y, state, lr=0.01)
             losses.append(float(loss))
         np.testing.assert_allclose(losses, pp1_losses, atol=2e-4, rtol=1e-4)
+
+    def test_pp4_params_sharded_quarter_memory(self):
+        """VERDICT r3 item 5 acceptance: with pp=4, each rank holds ~1/4
+        of the params (its padded stage slice), not a full replica."""
+        paddle.seed(5)
+        layers = [LayerDesc(nn.Linear, 128, 128) for _ in range(8)]
+        pl = PipelineLayer(layers, num_stages=4, loss_fn=nn.MSELoss(),
+                           seg_method="parameter")
+        eng = PipelineEngine(pl, num_microbatches=4,
+                             devices=jax.devices()[:4])
+        x = np.random.RandomState(0).randn(8, 128).astype(np.float32)
+        y = np.zeros((8, 128), np.float32)
+        state, _ = eng.train_batch(x, y, lr=0.01)
+        flat = state["flat"]
+        total_param_bytes = sum(
+            int(np.prod(p.shape)) * 4
+            for st in eng.state() for p in st.values())
+        shard_bytes = flat.addressable_shards[0].data.nbytes
+        # balanced stages: per-rank slice ~ total/4 (+ padding slack)
+        assert shard_bytes <= total_param_bytes / 4 * 1.2, \
+            (shard_bytes, total_param_bytes)
+        # and the stacked container itself is genuinely sharded over pp
+        assert len({s.device for s in flat.addressable_shards}) == 4
+
+    def test_shared_layer_grads_allreduced(self, pp1_losses):
+        """Tied layer on first and last stage: trains identically to the
+        single-stage run (grad psum over pp = the reference's
+        allreduce_shared_weight_gradients)."""
+        def descs():
+            return [
+                SharedLayerDesc("tied", nn.Linear, 16, 16),
+                LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 16, 16),
+                SharedLayerDesc("tied", nn.Linear, 16, 16),
+            ]
+
+        def run(stages, ndev):
+            paddle.seed(77)
+            pl = PipelineLayer(descs(), num_stages=stages,
+                               loss_fn=nn.MSELoss())
+            eng = PipelineEngine(pl, num_microbatches=2,
+                                 devices=jax.devices()[:ndev])
+            rng = np.random.RandomState(1)
+            x = rng.randn(4, 16).astype(np.float32)
+            y = rng.randn(4, 16).astype(np.float32)
+            state, losses = None, []
+            for _ in range(3):
+                state, loss = eng.train_batch(x, y, state, lr=0.05)
+                losses.append(float(loss))
+            return losses
+
+        np.testing.assert_allclose(run(2, 2), run(1, 1),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_unpack_round_trips_paramless_layers(self):
+        """unpack() must yield {} (not None) for ReLU-style layers so
+        load_state(unpack(packed)) restores checkpoints."""
+        paddle.seed(3)
+        pl = PipelineLayer([LayerDesc(nn.Linear, 8, 8),
+                            LayerDesc(nn.ReLU),
+                            LayerDesc(nn.Linear, 8, 8),
+                            LayerDesc(nn.ReLU)],
+                           num_stages=2, loss_fn=nn.MSELoss())
+        eng = PipelineEngine(pl, num_microbatches=2,
+                             devices=jax.devices()[:2])
+        x = np.ones((4, 8), np.float32)
+        y = np.zeros((4, 8), np.float32)
+        state, _ = eng.train_batch(x, y, lr=0.1)
+        logical = eng.unpack(state)
+        eng.load_state(logical)            # must not crash on ReLU
+        w_after = np.asarray(dict(pl.run_funcs[0].named_parameters())
+                             ["weight"].data)
+        np.testing.assert_allclose(
+            w_after, np.asarray(logical[0]["weight"]), atol=1e-6)
