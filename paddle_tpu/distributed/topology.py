@@ -108,9 +108,15 @@ def build_hybrid_mesh(*, ici=None, dcn=None, devices=None):
     if all(d == 1 for d in dcn_shape):
         total = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
         return build_mesh(**dict(zip(axes, total)), devices=devices)
+    devs = list(devices if devices is not None else jax.devices())
+    # TPU multi-slice topologies carry DISTINCT slice_index values; the
+    # multi-process CPU fixture reports slice_index 0 everywhere (or none
+    # at all), so there the process is the DCN granule
+    slices = {getattr(d, "slice_index", None) for d in devs}
+    use_slice = None not in slices and len(slices) > 1
     dev_grid = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape,
-        devices=devices if devices is not None else jax.devices())
+        ici_shape, dcn_shape, devices=devs,
+        process_is_granule=not use_slice)
     return Mesh(dev_grid, axes)
 
 

@@ -143,7 +143,7 @@ def _rebuild_batch(arrays, spec):
 
 
 def _worker_loop(loader, worker_id, num_workers, index_q, result_q,
-                 use_shared_memory, worker_init_fn):
+                 use_shared_memory, worker_init_fn, stop_event):
     global _worker_info
     _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
                               dataset=loader.dataset)
@@ -161,10 +161,14 @@ def _worker_loop(loader, worker_id, num_workers, index_q, result_q,
             # reference).  Batches are tagged (worker, local_idx) and
             # the parent interleaves round-robin.
             for i, batch in enumerate(loader._iter_batches()):
+                if stop_event.is_set():
+                    return                # abandoned: emit nothing more
                 _emit(result_q, (worker_id, i), batch, use_shared_memory)
             result_q.put(("done", worker_id, None, None))
             return
         while True:
+            if stop_event.is_set():
+                return
             job = index_q.get()
             if job is None:
                 result_q.put(("done", worker_id, None, None))
@@ -172,6 +176,8 @@ def _worker_loop(loader, worker_id, num_workers, index_q, result_q,
             i, indices = job
             batch = loader.collate_fn(
                 [loader.dataset[j] for j in indices])
+            if stop_event.is_set():
+                return
             _emit(result_q, i, batch, use_shared_memory)
     except KeyboardInterrupt:
         pass
@@ -208,6 +214,7 @@ class MultiprocessIter:
         # window-bounded anyway; +nw leaves room for the "done" marks
         self.result_q = ctx.Queue(
             maxsize=self.nw * loader.prefetch_factor + self.nw)
+        self._stop = ctx.Event()
         self.index_q = ctx.Queue() if not loader.iterable_mode else None
         self._procs = []
         self._n_batches = None
@@ -219,32 +226,52 @@ class MultiprocessIter:
             p = ctx.Process(
                 target=_worker_loop,
                 args=(loader, w, self.nw, self.index_q, self.result_q,
-                      loader.use_shared_memory, loader.worker_init_fn),
+                      loader.use_shared_memory, loader.worker_init_fn,
+                      self._stop),
                 daemon=True)
             p.start()
             self._procs.append(p)
 
+    def _drain_one(self, timeout=None):
+        """Pop-and-discard one pending result, unlinking its segment
+        (their trackers deregistered on ownership transfer — an undrained
+        message is a permanent /dev/shm leak)."""
+        kind, _, payload, _spec = (self.result_q.get_nowait() if timeout
+                                   is None else
+                                   self.result_q.get(timeout=timeout))
+        if kind == "shm":
+            name, _metas = payload
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+
     def _shutdown(self):
+        import time as _time
+
+        # cooperative stop first: a worker blocked in result_q.put()
+        # holds a live segment whose name hasn't reached us — keep
+        # draining so its put completes, it sees the stop event and
+        # exits, and the segment gets unlinked below
+        self._stop.set()
+        deadline = _time.monotonic() + 10.0
+        while (any(p.is_alive() for p in self._procs)
+               and _time.monotonic() < deadline):
+            try:
+                self._drain_one(timeout=0.05)
+            except pyqueue.Empty:
+                pass
         for p in self._procs:
             if p.is_alive():
                 p.terminate()
         for p in self._procs:
             p.join(timeout=1.0)
         self._procs = []
-        # drain undelivered results: their shm segments were deregistered
-        # from the workers' resource trackers (ownership had transferred
-        # to us), so unlink here or an early `break` leaks /dev/shm
         try:
             while True:
-                kind, _, payload, _spec = self.result_q.get_nowait()
-                if kind == "shm":
-                    name, _metas = payload
-                    try:
-                        seg = shared_memory.SharedMemory(name=name)
-                        seg.close()
-                        seg.unlink()
-                    except FileNotFoundError:
-                        pass
+                self._drain_one()
         except pyqueue.Empty:
             pass
 

@@ -193,3 +193,24 @@ class TestWorkerSafety:
         loader = DataLoader(Killer(), batch_size=4, num_workers=2)
         with pytest.raises(RuntimeError, match="died unexpectedly"):
             _collect(loader)
+
+    def test_iterable_early_break_unlinks_worker_held_shm(self):
+        """Iterable mode + bounded queue: a worker blocked in put() holds
+        a segment whose name hasn't reached the parent — the cooperative
+        stop must let it through for unlinking (review r4 regression)."""
+        import glob
+
+        class BigStream(IterableDataset):
+            def __iter__(self):
+                for i in range(500):
+                    yield np.full((256,), float(i), np.float32)
+
+        before = set(glob.glob("/dev/shm/psm_*"))
+        loader = DataLoader(BigStream(), batch_size=4, num_workers=2)
+        it = iter(loader)
+        next(it)
+        time.sleep(0.5)          # let workers run ahead and fill the queue
+        it.close()
+        time.sleep(0.3)
+        after = set(glob.glob("/dev/shm/psm_*"))
+        assert after - before == set(), f"leaked: {after - before}"
