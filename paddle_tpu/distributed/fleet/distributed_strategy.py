@@ -41,11 +41,16 @@ class DistributedStrategy:
         # gradient merge / accumulation
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
-        # comm-efficiency knobs kept for parity (no-ops where XLA owns fusion)
+        # comm-efficiency metas (meta_optimizers.py; fusion itself is
+        # XLA's on the jit path — these drive the eager/DCN path)
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4}
         self.dgc = False
+        self.dgc_configs = {"sparsity": 0.01, "momentum": 0.9,
+                            "rampup_begin_step": 0}
+        self.fp16_allreduce = False
         self.lars = False
         self.lamb = False
         self.find_unused_parameters = False

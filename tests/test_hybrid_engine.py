@@ -172,3 +172,37 @@ def test_params_stay_synced(baseline):
     flat_b = jax.tree_util.tree_leaves(params)
     for a, b in zip(flat_a, flat_b):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_bf16_opt_slots_train():
+    """opt_dtype='bfloat16' (reference Adam multi_precision=False): slots
+    store bf16, math in fp32 — training converges on the same curve
+    shape as fp32 slots (loose tolerance: bf16 master loses mantissa)."""
+    import jax
+
+    cfg = GPTConfig(vocab_size=256, max_seq_len=64, hidden=64,
+                    num_layers=2, num_heads=4, ffn_hidden=128,
+                    dtype="float32", use_flash=False, remat="nothing")
+
+    def run(opt_dtype):
+        eng = HybridEngine(cfg, engine_cfg=EngineConfig(
+            opt_dtype=opt_dtype), devices=jax.devices()[:1])
+        params, opt = eng.init(seed=0)
+        rng = np.random.RandomState(0)
+        tokens = rng.randint(0, 256, (8, 32)).astype(np.int32)
+        labels = np.concatenate([tokens[:, 1:], np.full((8, 1), -100)],
+                                1).astype(np.int32)
+        losses = []
+        for _ in range(5):
+            params, opt, loss = eng.step(params, opt, tokens, labels,
+                                         lr=1e-3)
+            losses.append(float(loss))
+        return losses, opt
+
+    l32, _ = run("float32")
+    l16, opt16 = run("bfloat16")
+    leaf = jax.tree_util.tree_leaves(opt16["slots"])[0]
+    assert leaf.dtype == jnp.bfloat16
+    assert all(np.isfinite(l16))
+    assert l16[-1] < l16[0]
+    np.testing.assert_allclose(l16, l32, rtol=0.05)

@@ -1,0 +1,142 @@
+"""Inference Config/create_predictor (reference strategy:
+inference/tests/api exercise AnalysisPredictor configs end-to-end; the
+int8 tests compare quantized outputs against fp32 within calibrated
+tolerance — mkldnn_quantizer_tester.cc pattern)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.contrib.quant import PTQ
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _calibrated(model, batches=4):
+    """PTQ-calibrate and return the activation scales keyed like
+    named_sublayers."""
+    ptq = PTQ()
+    ptq.quantize(model)
+    rng = np.random.RandomState(0)
+    for _ in range(batches):
+        model(paddle.to_tensor(rng.randn(8, 16).astype(np.float32)))
+    return {name: {"activation": s}
+            for name, s in ptq.scales().items()}
+
+
+class TestSavedProgramPath:
+    def test_native_precision_runs_saved_artifact(self, tmp_path):
+        paddle.seed(0)
+        model = MLP()
+        x = paddle.to_tensor(np.ones((4, 16), np.float32))
+        ref = np.asarray(model(x).data)
+        path = str(tmp_path / "mlp")
+        paddle.jit.save(model, path, example_inputs=[x])
+
+        pred = create_predictor(Config(path))
+        out = pred.run(np.ones((4, 16), np.float32))
+        np.testing.assert_allclose(np.asarray(out.data), ref, atol=1e-6)
+
+    def test_precision_override_requires_layer(self, tmp_path):
+        paddle.seed(0)
+        model = MLP()
+        x = paddle.to_tensor(np.ones((4, 16), np.float32))
+        path = str(tmp_path / "mlp")
+        paddle.jit.save(model, path, example_inputs=[x])
+        cfg = Config(path).set_precision(PrecisionType.Bfloat16)
+        with pytest.raises(ValueError, match="set_model"):
+            create_predictor(cfg)
+
+
+class TestPrecision:
+    def test_bf16_predictor(self, tmp_path):
+        paddle.seed(1)
+        model = MLP()
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        ref = np.asarray(model(paddle.to_tensor(x)).data)
+        path = str(tmp_path / "m")
+        paddle.jit.save(model, path,
+                        example_inputs=[paddle.to_tensor(x)])
+
+        cfg = Config(path).set_precision(PrecisionType.Bfloat16)
+        cfg.set_model(MLP())
+        out = create_predictor(cfg).run(x)
+        np.testing.assert_allclose(np.asarray(out.data).astype(np.float32),
+                                   ref, rtol=0.05, atol=0.05)
+
+    def test_int8_predictor_matches_fp32_within_tolerance(self):
+        paddle.seed(2)
+        model = MLP()
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 16).astype(np.float32)
+        ref = np.asarray(model(paddle.to_tensor(x)).data)
+
+        scales = _calibrated(MLP_copy(model))
+        cfg = Config().set_model(model)
+        cfg.enable_int8(scales)
+        pred = create_predictor(cfg)
+        out = np.asarray(pred.run(x).data)
+        # int8 quantization error bound: relative to output range
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert err < 0.1, err
+
+    def test_int8_requires_scales(self):
+        model = MLP()
+        cfg = Config().set_model(model)
+        cfg.enable_int8({})
+        with pytest.raises(ValueError, match="activation scale"):
+            create_predictor(cfg)
+
+    def test_int8_scales_from_json(self, tmp_path):
+        import json
+
+        paddle.seed(4)
+        model = MLP()
+        scales = _calibrated(MLP_copy(model))
+        p = tmp_path / "scales.json"
+        p.write_text(json.dumps(scales))
+        cfg = Config().set_model(model)
+        cfg.enable_int8(str(p))
+        pred = create_predictor(cfg)
+        out = pred.run(np.ones((2, 16), np.float32))
+        assert np.isfinite(np.asarray(out.data)).all()
+
+
+def MLP_copy(model):
+    """A weight-sharing copy for calibration (PTQ mutates hooks)."""
+    clone = MLP()
+    clone.set_state_dict({k: v for k, v in model.state_dict().items()})
+    return clone
+
+
+class TestModelUntouched:
+    def test_user_model_keeps_fp32_behavior_after_int8_build(self):
+        """create_predictor must not permanently monkey-patch the user's
+        layers: model(x) outside the predictor stays fp32-exact."""
+        paddle.seed(7)
+        model = MLP()
+        x = np.random.RandomState(5).randn(4, 16).astype(np.float32)
+        ref = np.asarray(model(paddle.to_tensor(x)).data)
+
+        scales = _calibrated(MLP_copy(model))
+        cfg = Config().set_model(model)
+        cfg.enable_int8(scales)
+        pred = create_predictor(cfg)
+        _ = pred.run(x)                       # traces with patches active
+        after = np.asarray(model(paddle.to_tensor(x)).data)
+        np.testing.assert_allclose(after, ref, atol=1e-6)
+        # and the predictor still serves int8 after the direct call
+        out = np.asarray(pred.run(x).data)
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert 0 < err < 0.1

@@ -1,0 +1,143 @@
+"""Comm-efficiency meta-optimizers (reference strategy: the dgc/localsgd
+optimizer unit tests assert the rewritten program's semantics; here the
+eager sync strategies are asserted numerically — residual conservation,
+sparsity, sync cadence)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.hybrid_optimizer import \
+    HybridParallelOptimizer
+from paddle_tpu.distributed.fleet.meta_optimizers import (BF16AllreduceSync,
+                                                          DGCSync, LocalSGD)
+from paddle_tpu.distributed.fleet.distributed_strategy import \
+    DistributedStrategy
+
+
+def _model_with_grads(seed=0):
+    paddle.seed(seed)
+    m = nn.Linear(8, 8)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 8).astype(np.float32))
+    (m(x) ** 2).mean().backward()
+    return m
+
+
+class TestDGC:
+    def test_topk_sparsity_and_residual_conservation(self):
+        m = _model_with_grads()
+        params = list(m.parameters())
+        dense = {id(p): np.asarray(p.grad.data).copy() for p in params}
+
+        sync = DGCSync(group=None, sparsity=0.1, momentum=0.0)
+        sync.sync(params)
+        for p in params:
+            g = np.asarray(p.grad.data)
+            nz = (g != 0).sum()
+            k = int(np.ceil(g.size * 0.1))
+            assert nz <= max(k, 1) + 1          # ties may widen by one
+            # exchanged + residual == the full gradient (nothing lost)
+            resid = np.asarray(sync._v[id(p)])
+            np.testing.assert_allclose(g + resid, dense[id(p)],
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_residual_drains_over_steps(self):
+        """With a CONSTANT gradient, repeated syncs must eventually ship
+        mass from every coordinate (the accumulate-then-send property)."""
+        m = _model_with_grads()
+        params = [p for p in m.parameters() if p.grad is not None]
+        p = params[0]
+        rng = np.random.RandomState(3)
+        # comparable magnitudes (0.5..1.5): every coordinate's residual
+        # grows at a similar rate, so accumulate-then-send must reach all
+        const = (rng.uniform(0.5, 1.5, np.asarray(p.grad.data).shape)
+                 * rng.choice([-1, 1], np.asarray(p.grad.data).shape)
+                 ).astype(np.float32)
+
+        sync = DGCSync(group=None, sparsity=0.05, momentum=0.0)
+        shipped = np.zeros_like(const)
+        for _ in range(60):
+            p.grad.data = paddle.to_tensor(const).data
+            sync.sync([p])
+            shipped += np.asarray(p.grad.data)
+        assert (np.abs(shipped) > 0).all()
+
+    def test_rampup_syncs_dense(self):
+        m = _model_with_grads()
+        params = list(m.parameters())
+        dense = {id(p): np.asarray(p.grad.data).copy() for p in params}
+        sync = DGCSync(group=None, sparsity=0.01, rampup_begin_step=5)
+        sync.sync(params)
+        for p in params:     # step 1 <= rampup: untouched (world=1 mean)
+            np.testing.assert_allclose(np.asarray(p.grad.data),
+                                       dense[id(p)])
+
+
+class TestBF16Allreduce:
+    def test_wire_roundtrip_quantizes_to_bf16(self):
+        m = _model_with_grads()
+        params = list(m.parameters())
+        dense = {id(p): np.asarray(p.grad.data).copy() for p in params}
+        BF16AllreduceSync(group=None).sync(params)
+        for p in params:
+            g = np.asarray(p.grad.data)
+            assert g.dtype == np.float32        # restored dtype
+            bf = dense[id(p)].astype("bfloat16" if hasattr(np, "bfloat16")
+                                     else np.float32)
+            # value equals the bf16-rounded gradient, not the fp32 one
+            import jax.numpy as jnp
+
+            expect = np.asarray(jnp.asarray(dense[id(p)], jnp.bfloat16)
+                                .astype(jnp.float32))
+            np.testing.assert_allclose(g, expect)
+
+
+class TestLocalSGD:
+    def test_sync_cadence(self):
+        m = _model_with_grads()
+        params = list(m.parameters())
+        ls = LocalSGD(group=None, k_steps=3)
+        synced = [ls.after_step(params) for _ in range(7)]
+        assert synced == [False, False, True, False, False, True, False]
+
+
+class TestHybridParallelOptimizer:
+    def _train(self, strategy, steps=3):
+        paddle.seed(9)
+        m = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        hopt = HybridParallelOptimizer(opt, hcg=None, strategy=strategy)
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        losses = []
+        for _ in range(steps):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            hopt.step()
+            hopt.clear_grad()
+            losses.append(float(loss.data))
+        return losses
+
+    def test_default_and_metas_train(self):
+        base = self._train(None)
+        assert base[-1] < base[0]
+        for knob in ("dgc", "localsgd", "fp16_allreduce"):
+            s = DistributedStrategy()
+            setattr(s, knob, True)
+            losses = self._train(s)
+            assert all(np.isfinite(losses)), knob
+            assert losses[-1] < losses[0], knob
+
+    def test_minimize_api(self):
+        paddle.seed(3)
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        hopt = HybridParallelOptimizer(opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        w0 = np.asarray(m.weight.data).copy()
+        hopt.minimize((m(x) ** 2).mean())
+        assert not np.allclose(np.asarray(m.weight.data), w0)
+        assert m.weight.grad is None    # cleared
