@@ -67,6 +67,9 @@ from . import contrib  # noqa: F401
 from . import device  # noqa: F401
 from . import vision  # noqa: F401
 from . import inference  # noqa: F401
+from . import signal  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
 from .framework_io import load, save  # noqa: F401
 
 # numpy-style creation with tensor return

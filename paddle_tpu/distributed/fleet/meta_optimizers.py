@@ -124,9 +124,6 @@ class LocalSGD:
         self.k_steps = k_steps
         self._step = 0
 
-    def sync_grads(self, params):
-        pass                                   # local steps: no grad comm
-
     def after_step(self, params):
         self._step += 1
         if self._step % self.k_steps != 0:

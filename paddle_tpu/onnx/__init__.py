@@ -1,0 +1,31 @@
+"""ONNX export (reference: python/paddle/onnx/export.py over paddle2onnx).
+
+Design decision (documented, deliberate): the portable serving artifact
+of this framework is the **versioned StableHLO program** produced by
+``jit.save``/``jax.export`` — it replays on any XLA runtime (TPU, GPU,
+CPU) with the calling convention embedded, and is what the Predictor
+(inference/) and the reference-parity ``jit.load`` consume.  An ONNX
+emitter would re-introduce the op-by-op converter matrix (paddle2onnx
+maintains ~200 converters against a GPU-centric opset) for no TPU-side
+gain.  ``paddle_tpu.onnx.export`` therefore produces the StableHLO
+artifact at the requested path and says so; consumers that genuinely
+need ``.onnx`` convert offline from StableHLO with third-party tooling.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """paddle.onnx.export signature parity; emits the StableHLO artifact
+    (see module docstring for why).  Returns the artifact prefix."""
+    from ..jit import save as jit_save
+
+    if input_spec is None:
+        raise ValueError(
+            "onnx.export needs input_spec (example inputs) to trace the "
+            "program — same requirement as the reference exporter")
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    jit_save(layer, path, example_inputs=list(input_spec))
+    return path

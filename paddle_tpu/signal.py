@@ -1,0 +1,139 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+Reference parity: python/paddle/signal.py (frame, overlap_add, stft,
+istft over the frame_op/overlap_add ops and paddle.fft).
+
+TPU-native notes: framing is a gather with a static index grid (one
+XLA gather, MXU-friendly downstream), overlap-add is a segment-sum via
+scatter-add; fft rides jnp.fft (XLA's native FFT).  All shapes static.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _check_axis(axis, what):
+    if axis not in (0, -1):
+        raise ValueError(f"{what} supports axis 0 or -1 (reference "
+                         f"signal.py contract), got {axis}")
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames (reference signal.py frame).
+    axis=-1: (..., n) → (..., frame_length, num_frames);
+    axis=0:  (n, ...) → (frame_length, num_frames, ...)."""
+    _check_axis(axis, "frame")
+    a = _arr(x)
+    if frame_length > a.shape[axis]:
+        raise ValueError(
+            f"frame_length ({frame_length}) > axis size ({a.shape[axis]})")
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    if axis == 0:
+        a = jnp.moveaxis(a, 0, -1)
+    n = a.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(num)[:, None])       # [num, flen]
+    out = a[..., idx]                                    # [..., num, flen]
+    out = jnp.swapaxes(out, -1, -2)                      # [..., flen, num]
+    if axis == 0:
+        out = jnp.moveaxis(out, (-2, -1), (0, 1))        # [flen, num, ...]
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames (reference overlap_add).
+    axis=-1: (..., frame_length, num) → (..., n);
+    axis=0:  (frame_length, num, ...) → (n, ...)."""
+    _check_axis(axis, "overlap_add")
+    a = _arr(x)
+    if axis == 0:
+        a = jnp.moveaxis(a, (0, 1), (-2, -1))
+    flen, num = a.shape[-2], a.shape[-1]
+    n = (num - 1) * hop_length + flen
+    seg = jnp.swapaxes(a, -1, -2)                        # [..., num, flen]
+    idx = (np.arange(flen)[None, :]
+           + hop_length * np.arange(num)[:, None])       # [num, flen]
+    out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+    out = out.at[..., idx].add(seg)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py stft).
+    x: [..., n]; returns [..., n_fft//2+1 or n_fft, num_frames] complex."""
+    a = _arr(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _arr(window).astype(jnp.float32)
+    # center-pad the window to n_fft (reference behavior)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(a, n_fft, hop_length)                 # [..., n_fft, num]
+    frames = jnp.swapaxes(frames, -1, -2) * win          # [..., num, n_fft]
+    spec = (jnp.fft.rfft(frames, n=n_fft, axis=-1) if onesided
+            else jnp.fft.fft(frames, n=n_fft, axis=-1))
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    spec = jnp.swapaxes(spec, -1, -2)                    # [..., freq, num]
+    return Tensor(spec) if isinstance(x, Tensor) else spec
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with the standard window-square normalization
+    (reference signal.py istft)."""
+    spec = _arr(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _arr(window).astype(jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames = jnp.swapaxes(spec, -1, -2)                  # [..., num, freq]
+    t = (jnp.fft.irfft(frames, n=n_fft, axis=-1) if onesided
+         else jnp.fft.ifft(frames, n=n_fft, axis=-1))
+    if not return_complex:
+        t = jnp.real(t)
+    t = t * win
+    y = overlap_add(jnp.swapaxes(t, -1, -2), hop_length)
+    # window-square envelope normalization
+    num = frames.shape[-2]
+    wsq = jnp.tile((win * win)[None, :], (num, 1))
+    env = overlap_add(jnp.swapaxes(wsq, -1, -2), hop_length)
+    y = y / jnp.maximum(env, 1e-10)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad:y.shape[-1] - pad]
+    if length is not None:
+        y = y[..., :length]
+    return Tensor(y) if isinstance(x, Tensor) else y

@@ -4,7 +4,9 @@ from __future__ import annotations
 from .. import nn
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "VGG", "vgg16", "MobileNetV1"]
+           "VGG", "vgg16", "MobileNetV1",
+           "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_1",
+           "ShuffleNetV2", "shufflenet_v2_x1_0", "DenseNet", "densenet121"]
 
 
 class LeNet(nn.Layer):
@@ -187,3 +189,207 @@ class MobileNetV1(nn.Layer):
     def forward(self, x):
         x = self.pool(self.features(x))
         return self.fc(x.flatten(1))
+
+
+class AlexNet(nn.Layer):
+    """Parity: vision/models/alexnet.py (the 2012 conv stack)."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2))
+        self.pool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(dropout), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(dropout), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+class _Fire(nn.Layer):
+    """SqueezeNet fire module (squeeze 1x1 -> expand 1x1 + 3x3 concat)."""
+
+    def __init__(self, inp, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(inp, squeeze, 1), nn.ReLU())
+        self.expand1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.expand3 = nn.Sequential(
+            nn.Conv2D(squeeze, e3, 3, padding=1), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        s = self.squeeze(x)
+        return paddle.concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Parity: vision/models/squeezenet.py (version 1.1 topology)."""
+
+    def __init__(self, num_classes=1000, version="1.1"):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+            nn.MaxPool2D(3, 2),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            nn.MaxPool2D(3, 2),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        return self.classifier(self.features(x)).flatten(1)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet(**kwargs)
+
+
+def _channel_shuffle(x, groups):
+    """ShuffleNet channel shuffle: interleave group channels (the
+    pointwise-group-conv information-mixing trick)."""
+    B, C, H, W = x.shape
+    return (x.reshape([B, groups, C // groups, H, W])
+             .transpose([0, 2, 1, 3, 4]).reshape([B, C, H, W]))
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, outp, stride):
+        super().__init__()
+        self.stride = stride
+        branch = outp // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            in2 = inp
+        else:
+            self.branch1 = None
+            in2 = inp // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.stride == 2:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Parity: vision/models/shufflenetv2.py (x1.0)."""
+
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024]}[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        inp = 24
+        stages = []
+        for outp, repeats in zip(stage_out[:3], (4, 8, 4)):
+            units = [_ShuffleUnit(inp, outp, 2)]
+            units += [_ShuffleUnit(outp, outp, 1) for _ in range(repeats - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = outp
+        self.stage2, self.stage3, self.stage4 = stages
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(inp, stage_out[3], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[3]), nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.stage4(self.stage3(self.stage2(x)))
+        x = self.pool(self.conv5(x))
+        return self.fc(x.flatten(1))
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        return paddle.concat([x, self.fn(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """Parity: vision/models/densenet.py (DenseNet-121 by default)."""
+
+    def __init__(self, num_classes=1000, growth_rate=32,
+                 block_config=(6, 12, 24, 16), bn_size=4,
+                 num_init_features=64):
+        super().__init__()
+        feats = [nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(num_init_features), nn.ReLU(),
+                 nn.MaxPool2D(3, 2, padding=1)]
+        ch = num_init_features
+        for bi, n in enumerate(block_config):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if bi != len(block_config) - 1:
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(**kwargs)

@@ -1,0 +1,225 @@
+"""signal / text / onnx / vision-zoo breadth (reference strategy:
+test_signal.py compares stft/istft against scipy-style references;
+test_viterbi_decode.py against a brute-force dynamic program; vision
+model tests are shape/forward smoke)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip_disjoint(self):
+        from paddle_tpu.signal import frame, overlap_add
+
+        x = np.arange(32, dtype=np.float32)
+        f = frame(x, 8, 8)               # disjoint frames
+        assert f.shape == (8, 4)
+        y = overlap_add(f, 8)
+        np.testing.assert_allclose(np.asarray(y), x)
+
+    def test_frame_values(self):
+        from paddle_tpu.signal import frame
+
+        x = np.arange(10, dtype=np.float32)
+        f = np.asarray(frame(x, 4, 2))
+        np.testing.assert_array_equal(f[:, 0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(f[:, 1], [2, 3, 4, 5])
+
+    def test_stft_matches_numpy_dft(self):
+        from paddle_tpu.signal import stft
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(256).astype(np.float32)
+        n_fft, hop = 64, 16
+        spec = np.asarray(stft(x, n_fft, hop_length=hop, center=False))
+        # frame 0 of the numpy reference
+        ref0 = np.fft.rfft(x[:n_fft])
+        np.testing.assert_allclose(spec[:, 0], ref0, atol=1e-4)
+        ref3 = np.fft.rfft(x[3 * hop:3 * hop + n_fft])
+        np.testing.assert_allclose(spec[:, 3], ref3, atol=1e-4)
+
+    def test_stft_istft_reconstruction(self):
+        from paddle_tpu.signal import istft, stft
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(512).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = stft(x, n_fft, hop_length=hop, window=win, center=True)
+        y = np.asarray(istft(spec, n_fft, hop_length=hop, window=win,
+                             center=True, length=512))
+        np.testing.assert_allclose(y, x, atol=1e-3)
+
+
+class TestViterbi:
+    @staticmethod
+    def _brute(emis, trans, start, stop):
+        """Exhaustive best-path search (tiny T, N)."""
+        import itertools
+
+        T, N = emis.shape
+        best, path = -1e30, None
+        for tags in itertools.product(range(N), repeat=T):
+            s = start[tags[0]] + emis[0, tags[0]]
+            for t in range(1, T):
+                s += trans[tags[t - 1], tags[t]] + emis[t, tags[t]]
+            s += stop[tags[-1]]
+            if s > best:
+                best, path = s, tags
+        return best, list(path)
+
+    def test_matches_bruteforce(self):
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 5, 4
+        emis = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        scores, paths = viterbi_decode(emis, trans, lengths=None,
+                                       include_bos_eos_tag=True)
+        start, stop = trans[N - 2], trans[:, N - 1]
+        for b in range(B):
+            s_ref, p_ref = self._brute(emis[b], trans, start, stop)
+            np.testing.assert_allclose(float(np.asarray(scores)[b]),
+                                       s_ref, rtol=1e-5)
+            assert list(np.asarray(paths)[b]) == p_ref
+
+    def test_variable_lengths(self):
+        from paddle_tpu.text import ViterbiDecoder
+
+        rng = np.random.RandomState(1)
+        B, T, N = 2, 6, 3
+        emis = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+        lens = np.array([4, 6], np.int32)
+        scores, paths = dec(paddle.to_tensor(emis),
+                            paddle.to_tensor(lens))
+        # batch 0's score must equal decoding its 4-step prefix alone
+        s_short, p_short = dec(paddle.to_tensor(emis[:1, :4]))
+        np.testing.assert_allclose(float(np.asarray(scores.data)[0]),
+                                   float(np.asarray(s_short.data)[0]),
+                                   rtol=1e-5)
+        assert (list(np.asarray(paths.data)[0][:4])
+                == list(np.asarray(p_short.data)[0]))
+
+
+class TestTextDatasets:
+    def test_uci_housing_parses_local_table(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+
+        rng = np.random.RandomState(0)
+        rows = rng.rand(20, 14).astype(np.float32)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, rows)
+        train = UCIHousing(data_file=str(f), mode="train")
+        test = UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 16 and len(test) == 4
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert x.min() >= 0.0 and x.max() <= 1.0
+
+    def test_imikolov_ngrams(self, tmp_path):
+        from paddle_tpu.text import Imikolov
+
+        f = tmp_path / "ptb.txt"
+        f.write_text("the cat sat on the mat\nthe dog sat\n")
+        ds = Imikolov(data_file=str(f), window_size=3)
+        assert len(ds) == 4 + 1
+        assert all(g.shape == (3,) for g in ds)
+
+    def test_no_egress_error_is_directed(self):
+        from paddle_tpu.text import UCIHousing, WMT14
+
+        with pytest.raises(FileNotFoundError, match="no network egress"):
+            UCIHousing()
+        with pytest.raises(FileNotFoundError, match="no network egress"):
+            WMT14()
+
+
+class TestOnnxDesignOut:
+    def test_export_emits_stablehlo_artifact(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import Predictor
+
+        model = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        path = paddle.onnx.export(model, str(tmp_path / "m.onnx"),
+                                  input_spec=[x])
+        pred = Predictor(path)
+        out = pred(np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.asarray(model(x).data), atol=1e-6)
+
+
+class TestVisionZoo:
+    @pytest.mark.parametrize("ctor,shape", [
+        ("alexnet", (1, 3, 224, 224)),
+        ("squeezenet1_1", (1, 3, 224, 224)),
+        ("shufflenet_v2_x1_0", (1, 3, 224, 224)),
+        ("densenet121", (1, 3, 64, 64)),
+    ])
+    def test_forward_shapes(self, ctor, shape):
+        from paddle_tpu.vision import models
+
+        paddle.seed(0)
+        model = getattr(models, ctor)(num_classes=10)
+        model.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(*shape).astype(np.float32))
+        out = model(x)
+        assert tuple(out.shape) == (1, 10)
+        assert np.isfinite(np.asarray(out.data)).all()
+
+
+class TestSignalAxis0:
+    def test_frame_axis0_layout(self):
+        from paddle_tpu.signal import frame
+
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        f = np.asarray(frame(x, 4, 2, axis=0))
+        assert f.shape == (4, 4, 2)          # (flen, num, batch)
+        np.testing.assert_array_equal(f[:, 0, 0], x[:4, 0])
+        np.testing.assert_array_equal(f[:, 1, 1], x[2:6, 1])
+
+    def test_overlap_add_axis0_inverts_frame(self):
+        from paddle_tpu.signal import frame, overlap_add
+
+        x = np.arange(16, dtype=np.float32).reshape(16, 1)
+        f = frame(x, 4, 4, axis=0)           # disjoint
+        y = np.asarray(overlap_add(f, 4, axis=0))
+        np.testing.assert_array_equal(y, x)
+
+    def test_bad_axis_is_loud(self):
+        from paddle_tpu.signal import frame
+
+        with pytest.raises(ValueError, match="axis 0 or -1"):
+            frame(np.zeros((4, 8), np.float32), 2, 1, axis=1)
+
+
+class TestImdbParse:
+    def test_parses_tar_with_min_freq_cutoff(self, tmp_path):
+        import io
+        import tarfile
+
+        from paddle_tpu.text import Imdb
+
+        tar_path = tmp_path / "aclImdb.tar.gz"
+        docs = {
+            "aclImdb/train/pos/0_9.txt": b"good good good film",
+            "aclImdb/train/neg/1_2.txt": b"bad bad film",
+            "aclImdb/test/pos/0_8.txt": b"ignored split",
+        }
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, body in docs.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+
+        ds = Imdb(data_file=str(tar_path), mode="train", cutoff=1)
+        assert len(ds) == 2
+        # cutoff=1 keeps words with freq > 1: good(3), bad(2), film(2)
+        assert set(ds.word_idx) == {"good", "bad", "film", "<unk>"}
+        labels = sorted(int(l) for _, l in [ds[i] for i in range(2)])
+        assert labels == [0, 1]
