@@ -310,8 +310,8 @@ def main():
         # full remat is the memory-safest but has crashed the remote
         # compile helper; gpt2-large is the graceful floor.
         ladder = [("gpt3-1.3b", dict(batch=2, seq=2048, accum=1,
-                                     remat="dots", opt_dtype="bfloat16")),
-                  ("gpt3-1.3b", dict(batch=4, seq=2048, accum=1,
+                                     remat="full", opt_dtype="bfloat16")),
+                  ("gpt3-1.3b", dict(batch=1, seq=2048, accum=1,
                                      remat="full", opt_dtype="bfloat16")),
                   ("gpt2-large", dict(batch=8, seq=1024, accum=2,
                                       remat="dots", opt_dtype="bfloat16"))]

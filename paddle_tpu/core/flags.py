@@ -69,3 +69,35 @@ define_flag("use_bf16_matmul", True, "prefer bf16 inputs on MXU matmuls")
 define_flag("seed", 0, "global random seed (0 = nondeterministic)")
 define_flag("tpu_interpret_pallas", False, "run pallas kernels in interpret mode")
 define_flag("log_level", 0, "framework VLOG-style verbosity")
+
+# --- allocator knobs (reference: FLAGS_fraction_of_gpu_memory_to_use +
+# FLAGS_allocator_strategy, allocator_facade.h:43).  On TPU the XLA/PJRT
+# client owns allocation; these flags configure IT via its env contract,
+# so they must be set before first device use. ----------------------------
+define_flag("fraction_of_device_memory_to_use", 0.0,
+            "0 = backend default; else sets XLA_PYTHON_CLIENT_MEM_FRACTION")
+define_flag("allocator_strategy", "auto_growth",
+            "'auto_growth' (XLA default, preallocate off) or 'preallocate'")
+
+
+def apply_allocator_flags():
+    """Push the allocator flags into the XLA client env (no-op after the
+    backend initialized — call before first device use, as the reference
+    requires for its allocator strategy)."""
+    import os
+
+    frac = flag("fraction_of_device_memory_to_use")
+    if frac and frac > 0:
+        os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(frac)
+    else:
+        os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
+    strategy = flag("allocator_strategy")
+    if strategy == "preallocate":
+        os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = "true"
+    elif strategy == "auto_growth":   # backend default: clear overrides
+        os.environ.pop("XLA_PYTHON_CLIENT_PREALLOCATE", None)
+    else:
+        raise ValueError(f"unknown allocator_strategy {strategy!r}")
+
+
+apply_allocator_flags()

@@ -729,7 +729,7 @@ class HybridEngine:
         z3_leaf = [self._z3() and "sharding" in self._leaf_axes(s)
                    for s in flat_specs]
 
-        def to_chunks(grads):
+        def to_chunks(grads, dtype=jnp.float32):
             """ZeRO chunking per leaf.
 
             check_vma AD already psum'd every grad over the axes its param
@@ -737,16 +737,24 @@ class HybridEngine:
             param's.  Each rank keeps its own 1/zr chunk; XLA's
             reduce-scatter-creator fuses the AD all-reduce with this slice
             into a reduce_scatter over 'sharding'.  stage-3 leaves arrive
-            already reduce-scattered (the all_gather transpose)."""
+            already reduce-scattered (the all_gather transpose).
+
+            ``dtype=None`` keeps each grad's own dtype — the single-step
+            (accum=1) path uses it so bf16 grads stay bf16 end to end:
+            the global-norm clip holds EVERY chunk live at once, and a
+            blanket fp32 cast doubles that footprint (the difference
+            between GPT-1.3B fitting one 16 GB chip or not); Adam's math
+            upcasts per leaf anyway."""
             flat_g = treedef.flatten_up_to(grads)
             chunks = []
             for g, z3 in zip(flat_g, z3_leaf):
+                dt = dtype or g.dtype
                 if z3:
-                    chunks.append(g.reshape(-1).astype(jnp.float32))
+                    chunks.append(g.reshape(-1).astype(dt))
                     continue
                 n = int(np.prod(g.shape))
                 chunk = -(-n // zr)
-                gf = jnp.pad(g.reshape(-1).astype(jnp.float32),
+                gf = jnp.pad(g.reshape(-1).astype(dt),
                              (0, zr * chunk - n))
                 chunks.append(jax.lax.dynamic_slice_in_dim(
                     gf.reshape(zr, chunk), zr_idx, 1, axis=0)[0])
@@ -754,7 +762,7 @@ class HybridEngine:
 
         if accum == 1:
             loss, grads = grad_fn(params, tokens, labels, key)
-            g_chunks = to_chunks(grads)
+            g_chunks = to_chunks(grads, dtype=None)
         else:
             # gradient merge (reference: gradient_merge_optimizer): scan
             # accum chunks of the local batch.  The carry holds only each
@@ -798,7 +806,8 @@ class HybridEngine:
         # HybridParallelClipGrad makes the same is_distributed distinction
         # (hybrid_parallel_optimizer.py:45)
         if ec.grad_clip and ec.grad_clip > 0:
-            gn_sq = sum(_psum_varying(jnp.sum(jnp.square(g)))
+            gn_sq = sum(_psum_varying(jnp.sum(jnp.square(
+                            g.astype(jnp.float32))))
                         for g in g_chunks)
             gnorm = jnp.sqrt(gn_sq)
             scale = jnp.minimum(1.0, ec.grad_clip / jnp.maximum(gnorm, 1e-12))
@@ -815,6 +824,7 @@ class HybridEngine:
             m_loc = slots["m"][0, 0, 0].astype(jnp.float32)   # [chunk]
             v_loc = slots["v"][0, 0, 0].astype(jnp.float32)
             w_loc = slots["master"][0, 0, 0].astype(jnp.float32)
+            g = g.astype(jnp.float32)
             m = b1 * m_loc + (1 - b1) * g
             v = b2 * v_loc + (1 - b2) * g * g
             m_hat = m / (1 - jnp.power(b1, stepf))

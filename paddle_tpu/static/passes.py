@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax.numpy as jnp
 
 from .program import OpDesc, Program, _ParamRef, _VarRef
@@ -111,3 +113,107 @@ class AmpBf16Pass(Pass):
 
         wrapped._amp_bf16_wrapped = True
         return wrapped
+
+
+@register_pass("constant_folding")
+class ConstantFoldingPass(Pass):
+    """Evaluate ops whose inputs are all compile-time constants and
+    splice the result in as a literal (reference:
+    framework/ir/constant_folding_pass.cc).  Plain captured tensors are
+    constants; trainable Parameters fold only when ``fold_params``
+    (inference mode) — training reads them live."""
+
+    def __init__(self, fold_params=False):
+        self.fold_params = fold_params
+
+    def apply(self, program, fetch_vids=()):
+        import jax
+
+        from ..core.tensor import Parameter
+
+        folded_vals = {}
+        count = 0
+        new_ops = []
+        for op in program.ops:
+            def resolve(leaf):
+                if isinstance(leaf, _VarRef):
+                    return folded_vals.get(leaf.vid, leaf)
+                if isinstance(leaf, _ParamRef):
+                    if self.fold_params or not isinstance(leaf.tensor,
+                                                          Parameter):
+                        return leaf.tensor.data
+                    return leaf
+                return leaf
+
+            res = [resolve(l) for l in op.leaves]
+            if any(isinstance(l, (_VarRef, _ParamRef)) for l in res):
+                # not fully constant: rewrite leaves that DID fold
+                op.leaves = res
+                new_ops.append(op)
+                continue
+            args, kwargs = jax.tree_util.tree_unflatten(op.treedef, res)
+            out = op.pure_fn(*args, **kwargs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for vid, o in zip(op.out_vids, outs):
+                folded_vals[vid] = o
+            count += 1
+        # fetched vids that folded away need a passthrough const op
+        for vid in fetch_vids:
+            if vid in folded_vals:
+                val = folded_vals[vid]
+                leaves, treedef = jax.tree_util.tree_flatten(((), {}))
+                new_ops.append(OpDesc("const", lambda v=val: v,
+                                      treedef, leaves, [vid]))
+        program.ops = new_ops
+        program.version += 1
+        return count
+
+
+@register_pass("common_subexpression_elimination")
+class CSEPass(Pass):
+    """Merge ops with identical (name, pure_fn, resolved inputs) —
+    framework/ir CSE analog.  VarRefs compare by vid, params by tensor
+    identity, array literals by raw bytes (repr elides large arrays and
+    would merge distinct constants), other literals by value repr."""
+
+    def apply(self, program, fetch_vids=()):
+        seen = {}          # key -> out_vids of the first occurrence
+        alias = {}         # dropped vid -> kept vid
+        kept = []
+        count = 0
+        for op in program.ops:
+
+            def leaf_key(leaf):
+                if isinstance(leaf, _VarRef):
+                    return ("v", alias.get(leaf.vid, leaf.vid))
+                if isinstance(leaf, _ParamRef):
+                    return ("p", id(leaf.tensor))
+                if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                    arr = np.asarray(leaf)
+                    return ("a", str(arr.dtype), arr.shape,
+                            arr.tobytes())
+                return ("l", repr(leaf))
+
+            # fresh leaf list: OpDesc.leaves objects are SHARED with the
+            # source program across clone() — in-place vid rewrites would
+            # leak into it (and past its version counter)
+            op.leaves = [
+                _VarRef(alias[l.vid])
+                if isinstance(l, _VarRef) and l.vid in alias else l
+                for l in op.leaves]
+            key = (op.name, id(op.pure_fn), op.treedef,
+                   tuple(leaf_key(l) for l in op.leaves))
+            prev = seen.get(key)
+            if (prev is not None and len(prev) == len(op.out_vids)
+                    and not any(v in fetch_vids for v in op.out_vids)):
+                # fetch targets keep their producer: replay fetches the
+                # vid directly, aliases are invisible to it
+                for dropped, kept_vid in zip(op.out_vids, prev):
+                    alias[dropped] = kept_vid
+                count += 1
+                continue
+            seen[key] = op.out_vids
+            kept.append(op)
+        program.ops = kept
+        program.version += 1
+        return count

@@ -89,10 +89,12 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     _, rev = jax.lax.scan(back, last_tag, backptrs, reverse=True)
     paths = jnp.concatenate([jnp.swapaxes(rev, 0, 1),
                              last_tag[:, None]], axis=1)   # [B, T]
-    wrap = isinstance(potentials, Tensor)
-    if wrap:
-        return Tensor(scores), Tensor(paths.astype(jnp.int64))
-    return scores, paths.astype(jnp.int64)
+    # int32 on purpose: jax truncates int64 without x64 mode (and warns
+    # per call); tag indices never need 64 bits
+    paths = paths.astype(jnp.int32)
+    if isinstance(potentials, Tensor):
+        return Tensor(scores), Tensor(paths)
+    return scores, paths
 
 
 class ViterbiDecoder(Layer):

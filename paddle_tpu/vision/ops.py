@@ -1,0 +1,85 @@
+"""Detection ops (reference parity: paddle.vision.ops — box_iou,
+nms, generate-anchor helpers over operators/detection/*).
+
+TPU-native notes: NMS is the classic dynamic-shape offender; the
+suppression decision here is the O(N^2) masked formulation — one [N, N]
+IoU matrix + a fixed-length lax.scan producing a static-shape KEEP MASK
+(operators/detection/nms_op.cc walks a sorted list with data-dependent
+erases instead).  The final mask→indices compaction is inherently
+dynamic-shape and happens at the host boundary; jit callers should
+consume the mask form.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["box_iou", "nms", "box_area"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _arr(boxes)
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return Tensor(area) if isinstance(boxes, Tensor) else area
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU of [N,4] x [M,4] xyxy boxes -> [N, M]."""
+    a, b = _arr(boxes1), _arr(boxes2)
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(area1[:, None] + area2[None] - inter, 1e-10)
+    return Tensor(iou) if isinstance(boxes1, Tensor) else iou
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (reference vision/ops.py nms): keeps the highest-score
+    box, suppresses overlaps above ``iou_threshold``, repeats.
+
+    Static-shape formulation: boxes are processed in score order under a
+    lax.scan over N steps; a keep mask accumulates.  Category-aware when
+    category_idxs given (boxes of different categories never suppress
+    each other).  Returns kept indices sorted by score (Tensor[int64]),
+    truncated to top_k when given.
+    """
+    b = _arr(boxes).astype(jnp.float32)
+    n = b.shape[0]
+    s = (_arr(scores).astype(jnp.float32) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    order = jnp.argsort(-s)
+    iou = box_iou(b, b)
+    iou = iou if not isinstance(iou, Tensor) else iou.data
+    if category_idxs is not None:
+        cats = _arr(category_idxs)
+        same = cats[:, None] == cats[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def step(keep, i):
+        idx = order[i]
+        # suppressed if any higher-scored KEPT box overlaps too much
+        earlier = order[:n]
+        rank = jnp.arange(n)
+        higher = rank < i
+        overlap = iou[idx, earlier] > iou_threshold
+        kept_earlier = keep[earlier]
+        suppressed = jnp.any(higher & overlap & kept_earlier)
+        keep = keep.at[idx].set(~suppressed)
+        return keep, None
+
+    keep, _ = jax.lax.scan(step, jnp.zeros((n,), bool), jnp.arange(n))
+    kept_sorted = order[keep[order]]          # score order, kept only
+    if top_k is not None:
+        kept_sorted = kept_sorted[:top_k]
+    out = kept_sorted.astype(jnp.int64)
+    return Tensor(out) if isinstance(boxes, Tensor) else out

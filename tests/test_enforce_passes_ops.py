@@ -1,0 +1,174 @@
+"""Enforce taxonomy, allocator flags, constant-folding/CSE passes,
+detection ops (reference strategy: per-pass program-rewrite assertions
+a la ir pass unit tests; nms against a numpy greedy reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.core import enforce as E
+
+
+class TestEnforce:
+    def test_taxonomy_catchable_both_ways(self):
+        with pytest.raises(E.EnforceNotMet):
+            E.enforce(False, "boom")
+        with pytest.raises(ValueError):       # dual-inherits ValueError
+            E.enforce(False, "boom")
+        with pytest.raises(E.NotFoundError):
+            E.enforce(False, "missing {}", "x", error_cls=E.NotFoundError)
+
+    def test_helpers(self):
+        E.enforce_eq(3, 3)
+        with pytest.raises(E.InvalidArgumentError, match="expected 4"):
+            E.enforce_eq(3, 4, what="rank")
+        with pytest.raises(E.InvalidArgumentError, match="must be > 0"):
+            E.enforce_gt(0, 0, what="hop")
+        E.enforce_shape(np.zeros((2, 3)), (2, -1))
+        with pytest.raises(E.InvalidArgumentError, match="shape mismatch"):
+            E.enforce_shape(np.zeros((2, 3)), (3, 3), what="weight")
+
+
+class TestAllocatorFlags:
+    def test_preallocate_strategy_sets_env(self, monkeypatch):
+        import os
+
+        from paddle_tpu.core import flags
+
+        monkeypatch.delenv("XLA_PYTHON_CLIENT_PREALLOCATE", raising=False)
+        flags.set_flags({"allocator_strategy": "preallocate",
+                         "fraction_of_device_memory_to_use": 0.5})
+        flags.apply_allocator_flags()
+        assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "true"
+        assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+        flags.set_flags({"allocator_strategy": "auto_growth",
+                         "fraction_of_device_memory_to_use": 0.0})
+
+
+class TestNewPasses:
+    def _program(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            c = paddle.to_tensor(np.float32(2.0)) * paddle.to_tensor(
+                np.float32(3.0))                      # fully constant
+            a = x * 2.0
+            b = x * 2.0                               # duplicate of a
+            out = a + b + c
+        return prog, x, out
+
+    def test_constant_folding(self):
+        prog, x, out = self._program()
+        n_before = len(prog.ops)
+        folded = static.new_pass("constant_folding").apply(prog, [])
+        assert folded >= 1
+        assert len(prog.ops) < n_before
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={"x": np.ones(4, np.float32)},
+                       fetch_list=[out], use_passes=())
+        np.testing.assert_allclose(r, np.ones(4) * 2 + np.ones(4) * 2 + 6)
+
+    def test_cse_merges_duplicates(self):
+        prog, x, out = self._program()
+        merged = static.new_pass(
+            "common_subexpression_elimination").apply(prog, [])
+        assert merged >= 1
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={"x": np.ones(4, np.float32)},
+                       fetch_list=[out], use_passes=())
+        np.testing.assert_allclose(r, np.ones(4) * 2 + np.ones(4) * 2 + 6)
+
+
+class TestDetectionOps:
+    def test_box_iou_known_values(self):
+        from paddle_tpu.vision.ops import box_iou
+
+        a = np.array([[0, 0, 2, 2]], np.float32)
+        b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]], np.float32)
+        iou = np.asarray(box_iou(a, b))
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+    @staticmethod
+    def _greedy_nms_ref(boxes, scores, thr):
+        idxs = list(np.argsort(-scores))
+        keep = []
+        while idxs:
+            i = idxs.pop(0)
+            keep.append(i)
+            rest = []
+            for j in idxs:
+                xx1 = max(boxes[i, 0], boxes[j, 0])
+                yy1 = max(boxes[i, 1], boxes[j, 1])
+                xx2 = min(boxes[i, 2], boxes[j, 2])
+                yy2 = min(boxes[i, 3], boxes[j, 3])
+                inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+                a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+                a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+                if inter / max(a1 + a2 - inter, 1e-10) <= thr:
+                    rest.append(j)
+            idxs = rest
+        return keep
+
+    def test_nms_matches_greedy_reference(self):
+        from paddle_tpu.vision.ops import nms
+
+        rng = np.random.RandomState(0)
+        xy = rng.rand(24, 2) * 10
+        wh = rng.rand(24, 2) * 4 + 0.5
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.rand(24).astype(np.float32)
+        got = list(np.asarray(nms(boxes, 0.4, scores=scores)))
+        ref = self._greedy_nms_ref(boxes, scores, 0.4)
+        assert got == ref
+
+    def test_nms_category_aware_and_topk(self):
+        from paddle_tpu.vision.ops import nms
+
+        boxes = np.array([[0, 0, 2, 2], [0, 0, 2, 2], [5, 5, 6, 6]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        # same box, different categories: both kept
+        got = list(np.asarray(nms(boxes, 0.5, scores=scores,
+                                  category_idxs=np.array([0, 1, 0]))))
+        assert got == [0, 1, 2]
+        got = list(np.asarray(nms(boxes, 0.5, scores=scores, top_k=1)))
+        assert got == [0]
+
+
+class TestCSERegressions:
+    def test_cse_keeps_fetched_duplicate(self):
+        """A fetch target must keep its producer even when another op is
+        identical (review r4: KeyError on replay otherwise)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            a = x * 2.0
+            b = x * 2.0
+        static.new_pass("common_subexpression_elimination").apply(
+            prog, [prog.lookup(b)])
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={"x": np.ones(4, np.float32)},
+                       fetch_list=[b], use_passes=())
+        np.testing.assert_allclose(r, np.full(4, 2.0))
+
+    def test_cse_does_not_mutate_source_program(self):
+        """Executor applies passes to a clone; the original program's
+        leaves must stay untouched (they are shared objects)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            a = x * 2.0
+            b = x * 2.0
+            out = a + b
+        import copy
+
+        before = [[getattr(l, "vid", repr(l)) for l in op.leaves]
+                  for op in prog.ops]
+        exe = static.Executor()
+        exe.run(prog, feed={"x": np.ones(4, np.float32)}, fetch_list=[out],
+                use_passes=("common_subexpression_elimination",
+                            "dead_code_elimination"))
+        after = [[getattr(l, "vid", repr(l)) for l in op.leaves]
+                 for op in prog.ops]
+        assert before == after
+        del copy
