@@ -214,7 +214,14 @@ def bench_resnet(batch=32, steps=5):
     step_t = (time.perf_counter() - t0) / steps
     ips = batch / step_t
     log(f"[resnet] {ips:.1f} imgs/sec (fwd+bwd)")
-    return {"imgs_per_sec": ips, "batch": batch}
+    return {"imgs_per_sec": ips, "batch": batch,
+            # BASELINE.md §3 protocol fields (VERDICT r3 weak #9: the
+            # number must not float free of its measurement conditions)
+            "protocol": {"model": "resnet50", "chips": 1,
+                         "mesh": {"dp": 1}, "global_batch": batch,
+                         "image_size": 224, "dtype": "float32",
+                         "direction": "fwd+bwd (no optimizer step)",
+                         "compiler": f"jax {jax.__version__}"}}
 
 
 def _resnet_subprocess(timeout_s=900):

@@ -34,7 +34,9 @@ def define_flag(name: str, default, help_str: str = ""):
     with _lock:
         env = os.environ.get(f"FLAGS_{name}")
         value = _coerce(env, default) if env is not None else default
-        _registry[name] = {"value": value, "default": default, "help": help_str}
+        _registry[name] = {"value": value, "default": default,
+                           "help": help_str,
+                           "explicit": env is not None}
     return value
 
 
@@ -59,6 +61,7 @@ def set_flags(mapping: dict):
             if name not in _registry:
                 raise KeyError(f"unknown flag: {name}")
             _registry[name]["value"] = _coerce(value, _registry[name]["default"])
+            _registry[name]["explicit"] = True
 
 
 # --- core flags (subset of the reference's 59, TPU-relevant ones) -----------
@@ -83,21 +86,27 @@ define_flag("allocator_strategy", "auto_growth",
 def apply_allocator_flags():
     """Push the allocator flags into the XLA client env (no-op after the
     backend initialized — call before first device use, as the reference
-    requires for its allocator strategy)."""
+    requires for its allocator strategy).
+
+    Only flags the user EXPLICITLY set (set_flags or FLAGS_* env) touch
+    the client env: a default-valued flag must never clobber the user's
+    own XLA_PYTHON_CLIENT_* variables at import."""
     import os
 
-    frac = flag("fraction_of_device_memory_to_use")
-    if frac and frac > 0:
-        os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(frac)
-    else:
-        os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
-    strategy = flag("allocator_strategy")
-    if strategy == "preallocate":
-        os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = "true"
-    elif strategy == "auto_growth":   # backend default: clear overrides
-        os.environ.pop("XLA_PYTHON_CLIENT_PREALLOCATE", None)
-    else:
-        raise ValueError(f"unknown allocator_strategy {strategy!r}")
+    if _registry["fraction_of_device_memory_to_use"]["explicit"]:
+        frac = flag("fraction_of_device_memory_to_use")
+        if frac and frac > 0:
+            os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(frac)
+        else:
+            os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
+    if _registry["allocator_strategy"]["explicit"]:
+        strategy = flag("allocator_strategy")
+        if strategy == "preallocate":
+            os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = "true"
+        elif strategy == "auto_growth":   # default: clear the override
+            os.environ.pop("XLA_PYTHON_CLIENT_PREALLOCATE", None)
+        else:
+            raise ValueError(f"unknown allocator_strategy {strategy!r}")
 
 
 apply_allocator_flags()

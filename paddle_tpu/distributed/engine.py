@@ -811,7 +811,9 @@ class HybridEngine:
                         for g in g_chunks)
             gnorm = jnp.sqrt(gn_sq)
             scale = jnp.minimum(1.0, ec.grad_clip / jnp.maximum(gnorm, 1e-12))
-            g_chunks = [g * scale for g in g_chunks]
+            # keep each chunk's dtype: fp32 scale would promote bf16
+            # chunks and double the all-chunks-live footprint
+            g_chunks = [(g * scale).astype(g.dtype) for g in g_chunks]
 
         # --- Adam on local chunks + weight decay + allgather params ---
         new_flat_p, new_flat_slots = [], []

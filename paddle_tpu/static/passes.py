@@ -23,6 +23,13 @@ __all__ = ["Pass", "register_pass", "new_pass", "PASS_REGISTRY",
 
 PASS_REGISTRY: dict[str, type] = {}
 
+# ops whose replay must DRAW, not replay a baked sample: folding or
+# merging them changes semantics (the reference constant_folding_pass
+# excludes nondeterministic ops the same way)
+RANDOM_OPS = {"rand", "randn", "randint", "randperm", "uniform", "normal",
+              "gaussian", "bernoulli", "multinomial", "exponential",
+              "poisson", "dropout", "rrelu", "shuffle"}
+
 
 def register_pass(name):
     def deco(cls):
@@ -135,6 +142,10 @@ class ConstantFoldingPass(Pass):
         count = 0
         new_ops = []
         for op in program.ops:
+            if op.name in RANDOM_OPS:
+                new_ops.append(op)
+                continue
+
             def resolve(leaf):
                 if isinstance(leaf, _VarRef):
                     return folded_vals.get(leaf.vid, leaf)
@@ -205,6 +216,7 @@ class CSEPass(Pass):
                    tuple(leaf_key(l) for l in op.leaves))
             prev = seen.get(key)
             if (prev is not None and len(prev) == len(op.out_vids)
+                    and op.name not in RANDOM_OPS
                     and not any(v in fetch_vids for v in op.out_vids)):
                 # fetch targets keep their producer: replay fetches the
                 # vid directly, aliases are invisible to it

@@ -36,13 +36,41 @@ class TestAllocatorFlags:
         from paddle_tpu.core import flags
 
         monkeypatch.delenv("XLA_PYTHON_CLIENT_PREALLOCATE", raising=False)
+        monkeypatch.delenv("XLA_PYTHON_CLIENT_MEM_FRACTION", raising=False)
         flags.set_flags({"allocator_strategy": "preallocate",
                          "fraction_of_device_memory_to_use": 0.5})
-        flags.apply_allocator_flags()
-        assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "true"
-        assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
-        flags.set_flags({"allocator_strategy": "auto_growth",
-                         "fraction_of_device_memory_to_use": 0.0})
+        try:
+            flags.apply_allocator_flags()
+            assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "true"
+            assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+        finally:
+            # reset flags AND re-apply so the env overrides are cleared
+            # for the rest of the process (monkeypatch then restores the
+            # pre-test values)
+            flags.set_flags({"allocator_strategy": "auto_growth",
+                             "fraction_of_device_memory_to_use": 0.0})
+            flags.apply_allocator_flags()
+
+    def test_default_flags_leave_user_env_alone(self, monkeypatch):
+        """import-time apply must not clobber the user's own
+        XLA_PYTHON_CLIENT_* variables when flags are defaults."""
+        import importlib
+        import os
+
+        from paddle_tpu.core import flags
+
+        monkeypatch.setenv("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.75")
+        saved = {n: dict(e) for n, e in flags._registry.items()}
+        try:
+            flags._registry["fraction_of_device_memory_to_use"][
+                "explicit"] = False
+            flags._registry["allocator_strategy"]["explicit"] = False
+            flags.apply_allocator_flags()
+            assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.75"
+        finally:
+            for n, e in saved.items():
+                flags._registry[n] = e
+        del importlib
 
 
 class TestNewPasses:
@@ -172,3 +200,23 @@ class TestCSERegressions:
                  for op in prog.ops]
         assert before == after
         del copy
+
+
+class TestRandomOpsSurviveOptimization:
+    def test_random_op_not_folded_or_merged(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            a = paddle.rand([4])
+            b = paddle.rand([4])
+            out = a + b
+        n0 = len(prog.ops)
+        static.new_pass("constant_folding").apply(prog, [prog.lookup(out)])
+        static.new_pass("common_subexpression_elimination").apply(prog, [])
+        names = [op.name for op in prog.ops]
+        assert names.count("rand") == 2, names   # neither folded nor merged
+        exe = static.Executor()
+        # the two draws stay INDEPENDENT (a merged/folded program would
+        # make out exactly 2*a); replay itself is deterministic by design
+        # (functional RNG keys are captured with the program)
+        (ra, rb) = exe.run(prog, feed={}, fetch_list=[a, b], use_passes=())
+        assert not np.allclose(ra, rb)
