@@ -25,3 +25,4 @@ from .sampler import (  # noqa: F401
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .multiprocess import WorkerInfo, get_worker_info  # noqa: F401
+from .industrial import InMemoryDataset, QueueDataset  # noqa: F401

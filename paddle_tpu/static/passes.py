@@ -142,10 +142,6 @@ class ConstantFoldingPass(Pass):
         count = 0
         new_ops = []
         for op in program.ops:
-            if op.name in RANDOM_OPS:
-                new_ops.append(op)
-                continue
-
             def resolve(leaf):
                 if isinstance(leaf, _VarRef):
                     return folded_vals.get(leaf.vid, leaf)
@@ -157,8 +153,12 @@ class ConstantFoldingPass(Pass):
                 return leaf
 
             res = [resolve(l) for l in op.leaves]
-            if any(isinstance(l, (_VarRef, _ParamRef)) for l in res):
-                # not fully constant: rewrite leaves that DID fold
+            if (op.name in RANDOM_OPS
+                    or any(isinstance(l, (_VarRef, _ParamRef))
+                           for l in res)):
+                # random ops never fold but STILL need their folded
+                # inputs spliced in (their producers may be removed);
+                # partially-constant ops likewise keep resolved leaves
                 op.leaves = res
                 new_ops.append(op)
                 continue

@@ -214,3 +214,62 @@ class TestWorkerSafety:
         time.sleep(0.3)
         after = set(glob.glob("/dev/shm/psm_*"))
         assert after - before == set(), f"leaked: {after - before}"
+
+
+class TestIndustrialDatasets:
+    def _write_slot_files(self, tmp_path, n_files=2, lines=6):
+        files = []
+        for fi in range(n_files):
+            f = tmp_path / f"part-{fi}.txt"
+            rows = []
+            for i in range(lines):
+                uid = fi * lines + i
+                rows.append(f"click:{uid % 2} slot1:{uid} slot1:{uid+100} "
+                            f"dense:{uid/10:.2f}")
+            f.write_text("\n".join(rows) + "\n")
+            files.append(str(f))
+        return files
+
+    def test_in_memory_load_shuffle_iterate(self, tmp_path):
+        from paddle_tpu.io import DataLoader, InMemoryDataset
+
+        files = self._write_slot_files(tmp_path)
+        ds = InMemoryDataset()
+        ds.init(use_slots=["click", "slot1", "dense"],
+                dense_slots=("dense",))
+        ds.set_filelist([str(tmp_path / "part-*.txt")])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 12
+        ids_before = [int(ds[i]["slot1"][0]) for i in range(12)]
+        ds.local_shuffle(seed=3)
+        ids_after = [int(ds[i]["slot1"][0]) for i in range(12)]
+        assert sorted(ids_after) == sorted(ids_before)   # same multiset
+        assert ids_after != ids_before                   # actually moved
+        order = [int(ds[i]["click"][0]) for i in range(12)]
+        assert sorted(order) == [0] * 6 + [1] * 6
+        assert ds[0]["dense"].dtype == np.float32
+        # feeds the regular loader stack unchanged
+        loader = DataLoader(ds, batch_size=4,
+                            collate_fn=lambda b: b)    # ragged: no stack
+        assert sum(len(b) for b in loader) == 12
+
+    def test_queue_dataset_streams_and_shards(self, tmp_path):
+        from paddle_tpu.io import DataLoader, QueueDataset
+
+        files = self._write_slot_files(tmp_path)
+        ds = QueueDataset()
+        ds.init(parse_fn=lambda line: np.asarray(
+            [float(t.split(":")[1]) for t in line.split()[:1]], np.float32))
+        ds.set_filelist(files)
+        # single process sees every line once
+        seen = [float(s[0]) for s in ds]
+        assert len(seen) == 12
+        # through the multiprocess loader with worker sharding
+        got = _collect(DataLoader(ds, batch_size=3, num_workers=2))
+        assert sum(b.shape[0] for b in got) == 12
+
+    def test_unloaded_access_is_loud(self):
+        from paddle_tpu.io import InMemoryDataset
+
+        with pytest.raises(RuntimeError, match="load_into_memory"):
+            len(InMemoryDataset())

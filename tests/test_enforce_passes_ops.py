@@ -220,3 +220,15 @@ class TestRandomOpsSurviveOptimization:
         # (functional RNG keys are captured with the program)
         (ra, rb) = exe.run(prog, feed={}, fetch_list=[a, b], use_passes=())
         assert not np.allclose(ra, rb)
+
+    def test_random_op_consuming_folded_constant(self):
+        """A random op fed by a folded-away producer must get the folded
+        VALUE spliced into its leaves, not a dangling vid (review r4)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            p = paddle.ones([4]) * 0.3
+            x = paddle.bernoulli(p)
+        static.new_pass("constant_folding").apply(prog, [prog.lookup(x)])
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={}, fetch_list=[x], use_passes=())
+        assert set(np.unique(r)).issubset({0.0, 1.0})
