@@ -841,11 +841,20 @@ class HybridEngine:
                 # stage-3: the param stays sharded — the updated chunk IS
                 # the new local param (no allgather; the forward gathers JIT)
                 new_p = w_new.reshape(p.shape).astype(p.dtype)
+            elif zr == 1:
+                # chunk == full param: psum over the size-1 axis is the
+                # type-level varying→invariant cast and compiles to a
+                # copy — the scatter-into-zeros path below materializes
+                # an extra full-width fp32 temp PER LEAF and breaks the
+                # elementwise fusion (the difference between GPT-1.3B
+                # fitting one chip or blowing HBM by 9G at compile)
+                full = jax.lax.psum(w_new, "sharding")
+                new_p = full.reshape(p.shape).astype(p.dtype)
             else:
                 # rebuild the full fp32 param: scatter own chunk into zeros
                 # and psum over 'sharding' (psum is the only
                 # varying→invariant cast, so this is the type-correct
-                # all_gather; also identity at zr==1)
+                # all_gather)
                 full = jnp.zeros((zr * w_new.shape[0],), jnp.float32)
                 full = jax.lax.dynamic_update_slice(
                     full, w_new, (zr_idx * w_new.shape[0],))

@@ -7,3 +7,5 @@ from . import custom_op
 from .custom_op import CustomOpBuilder, custom_op as build_op
 
 __all__ = ["custom_op", "CustomOpBuilder", "build_op"]
+
+from . import nn  # noqa: F401

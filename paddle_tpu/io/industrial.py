@@ -64,6 +64,12 @@ class InMemoryDataset(Dataset):
     # ---- configuration (init(...) keyword parity) --------------------
     def init(self, use_var=None, parse_fn=None, use_slots=None,
              dense_slots=(), **kwargs):
+        if "pipe_command" in kwargs:
+            raise NotImplementedError(
+                "pipe_command preprocessing is not supported: do the "
+                "transform in parse_fn (runs per line at load) instead")
+        if kwargs:
+            raise TypeError(f"unknown init() options: {sorted(kwargs)}")
         self._parse_fn = parse_fn
         self._slots = list(use_slots) if use_slots else None
         self._dense = tuple(dense_slots)
@@ -90,6 +96,7 @@ class InMemoryDataset(Dataset):
 
     def load_into_memory(self):
         """Parse every file into host memory (LoadIntoMemory)."""
+        self._globally_partitioned = False
         samples = []
         for path in self._filelist:
             with open(path) as f:
@@ -127,9 +134,17 @@ class InMemoryDataset(Dataset):
         self._require_loaded()
         base = 42 if seed is None else seed
         if identical_filelist and nranks > 1:
+            if getattr(self, "_globally_partitioned", False):
+                raise RuntimeError(
+                    "global_shuffle(identical_filelist=True) already "
+                    "partitioned this dataset across ranks; a second "
+                    "call would shrink the corpus geometrically. "
+                    "Reload (load_into_memory) before re-partitioning, "
+                    "or use local_shuffle for per-epoch shuffling.")
             rng = random.Random(base)          # same permutation everywhere
             rng.shuffle(self._samples)
             self._samples = self._samples[rank::nranks]
+            self._globally_partitioned = True
         else:
             rng = random.Random(base + rank)   # decorrelated, nothing lost
             rng.shuffle(self._samples)

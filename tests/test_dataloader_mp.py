@@ -273,3 +273,19 @@ class TestIndustrialDatasets:
 
         with pytest.raises(RuntimeError, match="load_into_memory"):
             len(InMemoryDataset())
+
+    def test_global_shuffle_guards(self, tmp_path):
+        from paddle_tpu.io import InMemoryDataset
+
+        self._write_slot_files(tmp_path)
+        ds = InMemoryDataset()
+        ds.init(use_slots=["click"])
+        ds.set_filelist([str(tmp_path / "part-*.txt")])
+        ds.load_into_memory()
+        n = ds.get_memory_data_size()
+        ds.global_shuffle()                 # 1 process: decorrelated local
+        assert ds.get_memory_data_size() == n
+        with pytest.raises(NotImplementedError, match="pipe_command"):
+            InMemoryDataset().init(pipe_command="awk ...")
+        with pytest.raises(TypeError, match="unknown init"):
+            InMemoryDataset().init(bogus=1)

@@ -223,3 +223,73 @@ class TestImdbParse:
         assert set(ds.word_idx) == {"good", "bad", "film", "<unk>"}
         labels = sorted(int(l) for _, l in [ds[i] for i in range(2)])
         assert labels == [0, 1]
+
+
+class TestFusedTransformer:
+    def test_fused_attention_matches_unfused_math(self):
+        """The fused layer must equal the hand-computed pre-LN qkv/attn/
+        proj/residual chain (fused_attention_op semantics)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        paddle.seed(0)
+        layer = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0,
+                                        normalize_before=True)
+        layer.eval()
+        x = np.random.RandomState(0).randn(2, 8, 32).astype(np.float32)
+        out = np.asarray(layer(paddle.to_tensor(x)).data)
+
+        # manual reference
+        import jax
+
+        def ln(a, g, b):
+            mu = a.mean(-1, keepdims=True)
+            var = a.var(-1, keepdims=True)
+            return (a - mu) / np.sqrt(var + 1e-5) * g + b
+
+        g = np.asarray(layer.ln_scale.data)
+        bb = np.asarray(layer.ln_bias.data)
+        W = np.asarray(layer.qkv_weight.data)
+        bqkv = np.asarray(layer.qkv_bias.data)
+        Wo = np.asarray(layer.linear_weight.data)
+        bo = np.asarray(layer.linear_bias.data)
+        h = ln(x, g, bb)
+        qkv = (h @ W + bqkv).reshape(2, 8, 3, 4, 8)
+        q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
+        att = q @ k.transpose(0, 1, 3, 2) / np.sqrt(8.0)
+        att = np.exp(att - att.max(-1, keepdims=True))
+        att = att / att.sum(-1, keepdims=True)
+        o = (att @ v).transpose(0, 2, 1, 3).reshape(2, 8, 32)
+        ref = x + (o @ Wo + bo)
+        np.testing.assert_allclose(out, ref, atol=2e-4)
+
+    def test_fused_encoder_layer_trains(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+        paddle.seed(1)
+        layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0,
+                                             normalize_before=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=layer.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, 32).astype(np.float32))
+        losses = []
+        for _ in range(4):
+            loss = (layer(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.data))
+        assert losses[-1] < losses[0]
+
+    def test_fused_attention_rejects_unsupported(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        with pytest.raises(NotImplementedError, match="need_weights"):
+            FusedMultiHeadAttention(32, 4, need_weights=True)
+        layer = FusedMultiHeadAttention(32, 4)
+        with pytest.raises(NotImplementedError, match="cache"):
+            layer(paddle.to_tensor(np.ones((1, 4, 32), np.float32)),
+                  cache=("k", "v"))
