@@ -4,9 +4,17 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-Primary metric: GPT tokens/sec/chip (largest BASELINE GPT config that fits
-one chip's HBM), measured with the Benchmark timer (reference semantics:
+Primary metric: GPT tokens/sec/chip on the largest BASELINE GPT config
+that fits one chip's HBM (gpt3-1.3b headline, gpt2-medium continuity),
+measured with the Benchmark timer (reference semantics:
 python/paddle/profiler/timer.py:325 — skip warmup, steady-state ips).
+
+Process architecture: every section runs in its OWN subprocess.  One
+section's OOM must not poison another — in round 4 a single 1.3B compile
+OOM cascaded into RESOURCE_EXHAUSTED failures for gpt2-large AND the
+flash microbenchmark in the same process.  On an HBM OOM the subprocess
+stderr carries XLA's memory breakdown; the orchestrator greps it and
+records the peak-bytes summary in the bench extra.
 
 vs_baseline derivation (north star: GPT-3 6.7B at >=50% of A100+NCCL
 tokens/sec/chip): A100 bf16 peak 312 TF at the ~45% MFU Megatron reports
@@ -20,11 +28,17 @@ Progress goes to stderr; stdout carries only the JSON line.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
 
 
 def log(msg):
@@ -40,6 +54,24 @@ PEAK_TFLOPS = {
 
 A100_EFFECTIVE_TF = 312.0 * 0.45      # Megatron-class A100 utilisation
 NORTH_STAR_FRACTION = 0.5
+
+# The 1.3B single-chip ladder: each rung is tried in its own subprocess,
+# first success wins.  Memory levers walked: batch size, then sequence
+# length (VERDICT r4 weak #2: the ladder must walk memory levers, not
+# just configs).  All rungs use master-less bf16 Adam slots (8 B/param
+# steady state) + full per-block remat.
+LADDER_13B = [
+    ("gpt3-1.3b", dict(batch=4, seq=2048, accum=1, remat="full",
+                       opt_dtype="bfloat16")),
+    ("gpt3-1.3b", dict(batch=2, seq=2048, accum=1, remat="full",
+                       opt_dtype="bfloat16")),
+    ("gpt3-1.3b", dict(batch=1, seq=2048, accum=1, remat="full",
+                       opt_dtype="bfloat16")),
+    ("gpt3-1.3b", dict(batch=2, seq=1024, accum=1, remat="full",
+                       opt_dtype="bfloat16")),
+    ("gpt2-large", dict(batch=8, seq=1024, accum=2, remat="dots",
+                        opt_dtype="bfloat16")),
+]
 
 
 def device_peak_tflops():
@@ -73,10 +105,7 @@ def bench_gpt(name, steps, warmup, batch, seq, accum=4, remat="dots",
     # persistent compile cache: the 1.3B program takes 15-25 min to
     # compile over the remote-compile tunnel; a retry (or the driver's
     # round-end run) must not pay that twice
-    import os
-
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_bench_cache")
+    cache_dir = os.path.join(HERE, ".jax_bench_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
@@ -192,7 +221,11 @@ def bench_flash_vs_xla():
 
 
 def bench_resnet(batch=32, steps=5):
-    """ResNet-50 imgs/sec (single-device jit train step)."""
+    """ResNet-50 imgs/sec: bf16 compute (AMP O2: conv/fc weights and
+    activations bf16, norms + optimizer fp32), train-mode BN, SGD-momentum
+    optimizer step included — BASELINE.md protocol item 3 (VERDICT r4
+    weak #3: fp32 fwd+bwd w/o optimizer is not comparable to any published
+    ResNet-50 training number)."""
     import jax
     import jax.numpy as jnp
 
@@ -200,73 +233,189 @@ def bench_resnet(batch=32, steps=5):
     from paddle_tpu.vision.models import resnet50
 
     model = resnet50(num_classes=1000)
-    opt = paddle.optimizer.Momentum(learning_rate=0.1,
-                                    parameters=model.parameters())
-    state = model.raw_state()   # (params, buffers) pytree pair
+    model.train()
+    params0, buffers0 = model.raw_state()
     images = jnp.asarray(
         np.random.RandomState(0).rand(batch, 3, 224, 224).astype(np.float32))
     labels = jnp.asarray(
         np.random.RandomState(1).randint(0, 1000, (batch,)))
 
-    def loss_fn(state, images, labels):
-        with model.swap_state(*state):
-            logits = model(paddle.Tensor(images))
-            loss = paddle.nn.functional.cross_entropy(
-                logits, paddle.Tensor(labels))
-        return loss.data if hasattr(loss, "data") else loss
+    def cast_amp(p):
+        # AMP O2: matrix/conv weights bf16, vectors (norm gammas/betas,
+        # biases) fp32
+        return p.astype(jnp.bfloat16) if p.ndim >= 2 else p
 
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    def loss_and_buffers(params, buffers, images, labels):
+        amp_params = {k: cast_amp(v) for k, v in params.items()}
+        with model.swap_state(amp_params, buffers):
+            logits = model(paddle.Tensor(images.astype(jnp.bfloat16)))
+            loss = paddle.nn.functional.cross_entropy(
+                logits.astype("float32"), paddle.Tensor(labels))
+            # train-mode BN mutated the buffer Tensors in place; capture
+            # the traced values before swap_state restores storage
+            new_buffers = {k: v.data for k, v in model.named_buffers()
+                           if v is not None}
+        return (loss.data if hasattr(loss, "data") else loss), new_buffers
+
+    mu, lr = 0.9, 0.1
+
+    def train_step(params, vel, buffers, images, labels):
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_and_buffers, has_aux=True)(params, buffers, images, labels)
+        new_vel = {k: mu * vel[k] + grads[k].astype(jnp.float32)
+                   for k in vel}
+        new_params = {k: params[k] - lr * new_vel[k] for k in params}
+        return new_params, new_vel, new_buffers, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    vel = {k: jnp.zeros_like(v) for k, v in params0.items()}
+    params, buffers = params0, buffers0
     t0 = time.perf_counter()
-    loss, grads = grad_fn(state, images, labels)
+    params, vel, buffers, loss = step(params, vel, buffers, images, labels)
     float(loss)
-    log(f"[resnet] grad compile+run {time.perf_counter()-t0:.1f}s")
+    log(f"[resnet] compile+first step {time.perf_counter()-t0:.1f}s")
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss, grads = grad_fn(state, images, labels)
+        params, vel, buffers, loss = step(params, vel, buffers, images,
+                                          labels)
     float(loss)
     step_t = (time.perf_counter() - t0) / steps
     ips = batch / step_t
-    log(f"[resnet] {ips:.1f} imgs/sec (fwd+bwd)")
+    log(f"[resnet] {ips:.1f} imgs/sec (bf16 fwd+bwd+momentum)")
     return {"imgs_per_sec": ips, "batch": batch,
-            # BASELINE.md §3 protocol fields (VERDICT r3 weak #9: the
-            # number must not float free of its measurement conditions)
             "protocol": {"model": "resnet50", "chips": 1,
                          "mesh": {"dp": 1}, "global_batch": batch,
-                         "image_size": 224, "dtype": "float32",
-                         "direction": "fwd+bwd (no optimizer step)",
+                         "image_size": 224, "dtype": "bfloat16",
+                         "norms_dtype": "float32",
+                         "direction": "fwd+bwd+momentum step (train BN)",
                          "compiler": f"jax {jax.__version__}"}}
 
 
-def _resnet_subprocess(timeout_s=900):
-    """ResNet in a subprocess with a hard timeout: conv-grad compiles hang
-    for unbounded time on some backends, and the secondary metric must
-    never sink the primary one (VERDICT r2 weak #4)."""
-    import subprocess
-    import sys
+def bench_ps(rows=100_000, dim=64, batch=4096):
+    """Sparse parameter-server scale check: a 100k-row table pulled and
+    pushed through the PSClient in loader-sized batches, reporting
+    pull/push latency (VERDICT r4 weak #8: the PS was never exercised at
+    its stated scale).  Pure host benchmark — no TPU."""
+    from paddle_tpu.distributed.ps import PSClient, PSServer, SparseTable
 
+    servers = [PSServer(), PSServer()]
+    try:
+        client = PSClient([s.endpoint for s in servers])
+        table = SparseTable(client, "bench_emb", dim=dim, init_std=0.01,
+                            seed=0)
+        ids = np.arange(rows)
+        pull_ts, push_ts = [], []
+        t_all = time.perf_counter()
+        for lo in range(0, rows, batch):
+            chunk = ids[lo:lo + batch]
+            t0 = time.perf_counter()
+            vals = table.pull(chunk)
+            pull_ts.append(time.perf_counter() - t0)
+            grad = np.full((len(chunk), dim), 1e-3, np.float32)
+            t0 = time.perf_counter()
+            table.push(chunk, grad)
+            push_ts.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_all
+        assert vals.shape == (len(chunk), dim)
+        out = {
+            "rows": rows, "dim": dim, "batch": batch,
+            "rows_per_sec": rows / wall,
+            "pull_ms_p50": float(np.median(pull_ts) * 1e3),
+            "push_ms_p50": float(np.median(push_ts) * 1e3),
+            "servers": len(servers),
+        }
+        log(f"[ps] {rows} rows dim={dim}: {out['rows_per_sec']:.0f} "
+            f"rows/s, pull p50 {out['pull_ms_p50']:.1f}ms, "
+            f"push p50 {out['push_ms_p50']:.1f}ms")
+        return out
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -------------------------------------------------- subprocess plumbing
+
+
+def _oom_summary(text):
+    """Extract XLA's HBM OOM breakdown from subprocess output, if any."""
+    m = re.search(r"Ran out of memory in memory space hbm\..*?hbm", text)
+    if not m:
+        return None
+    out = {"oom": m.group(0)[:300]}
+    mb = re.search(
+        r"Total hbm usage[^\n]*\n(?:[^\n]*\n){0,4}", text)
+    if mb:
+        out["breakdown"] = " | ".join(
+            line.strip() for line in mb.group(0).splitlines() if line.strip())
+    return out
+
+
+def _last_json(text):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except Exception:
+                continue
+    return None
+
+
+def _run_section(args_list, timeout_s, tag):
+    """Run `python bench.py <args_list>` in a subprocess; return its JSON
+    or an error dict with the OOM breakdown when XLA ran out of HBM."""
+    log(f"[{tag}] subprocess: {' '.join(args_list)}")
     try:
         proc = subprocess.run(
-            [sys.executable, __file__, "--resnet-only"],
-            capture_output=True, text=True, timeout=timeout_s)
-        for line in proc.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                return json.loads(line)
-        return {"error": f"rc={proc.returncode}: {proc.stderr[-200:]}"}
+            [sys.executable, os.path.abspath(__file__)] + args_list,
+            capture_output=True, text=True, timeout=timeout_s, cwd=HERE)
     except subprocess.TimeoutExpired:
-        return {"error": f"timeout after {timeout_s}s (conv-grad compile)"}
+        return {"error": f"timeout after {timeout_s}s"}
+    data = _last_json(proc.stdout)
+    if proc.returncode == 0 and data is not None:
+        return data
+    err = {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    oom = _oom_summary(proc.stderr + proc.stdout)
+    if oom:
+        err["hbm"] = oom
+        err["error"] = f"rc={proc.returncode}: HBM OOM (see hbm)"
+    return err
+
+
+# ---------------------------------------------------- regression gating
+
+
+def _current_round():
+    """The round now being benched: VERDICT.md says the PREVIOUS round
+    (judge output), so current = that + 1.  Fallback: one past the
+    highest BENCH_r*.json on disk."""
+    try:
+        with open(os.path.join(HERE, "VERDICT.md")) as f:
+            m = re.search(r"Round (\d+)", f.read(2000))
+        if m:
+            return int(m.group(1)) + 1
+    except Exception:
+        pass
+    rounds = []
+    for p in glob.glob(os.path.join(HERE, "BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json", os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)))
+    return (max(rounds) + 1) if rounds else 1
 
 
 def prior_best():
-    """Best tokens/s per GPT config across earlier rounds' BENCH_r*.json —
-    the regression baseline (reference: tools/check_op_benchmark_result.py
-    gates op benches against logged history the same way)."""
-    import glob
-    import os
-
+    """Best tokens/s per (config, batch, seq) across PRIOR rounds'
+    BENCH_r*.json — the regression baseline (reference:
+    tools/check_op_benchmark_result.py gates op benches against logged
+    history the same way).  The current round's own file is excluded so a
+    same-round rerun never gates against its own noise (ADVICE r4)."""
+    cur = _current_round()
     best = {}
-    here = os.path.dirname(os.path.abspath(__file__))
-    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+    for path in sorted(glob.glob(os.path.join(HERE, "BENCH_r*.json"))):
+        m = re.match(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if m and int(m.group(1)) >= cur:
+            continue
         try:
             with open(path) as f:
                 data = json.load(f)
@@ -287,6 +436,9 @@ def prior_best():
     return best
 
 
+# -------------------------------------------------------- orchestration
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
@@ -296,73 +448,97 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--no-resnet", action="store_true")
     ap.add_argument("--no-13b", action="store_true",
-                    help="skip the gpt3-1.3b headline run")
-    ap.add_argument("--resnet-only", action="store_true",
-                    help="internal: run just ResNet, print its JSON")
+                    help="skip the gpt3-1.3b headline ladder")
     ap.add_argument("--no-flash-micro", action="store_true")
+    ap.add_argument("--no-ps", action="store_true")
+    ap.add_argument("--section",
+                    choices=["gpt", "rung", "flash", "resnet", "ps"],
+                    help="internal: run ONE section in-process, print "
+                         "its JSON")
+    ap.add_argument("--rung", type=int, default=0,
+                    help="internal: LADDER_13B index for --section rung")
+    ap.add_argument("--gpt-config", default="gpt2-medium",
+                    help="internal: config for --section gpt")
     args = ap.parse_args()
 
-    import jax
-
-    if args.resnet_only:
+    # ---- section mode: one measurement, one JSON line ----
+    if args.section == "gpt":
+        # no in-process fallback: a failed attempt can poison the process
+        # (r4 cascade) — the orchestrator retries gpt2-small in a FRESH
+        # subprocess via --gpt-config
+        out = bench_gpt(args.gpt_config, args.steps, args.warmup,
+                        args.batch, args.seq, accum=args.accum)
+        print(json.dumps(out))
+        return
+    if args.section == "rung":
+        name, kw = LADDER_13B[args.rung]
+        print(json.dumps(bench_gpt(
+            name, max(args.steps // 2, 5), args.warmup, **kw)))
+        return
+    if args.section == "flash":
+        out = bench_flash_vs_xla()
+        # None = flash kernel not available on this backend: a clean
+        # skip, not a failure
+        print(json.dumps(out if out is not None else {"skipped": True}))
+        return
+    if args.section == "resnet":
         print(json.dumps(bench_resnet()))
         return
+    if args.section == "ps":
+        print(json.dumps(bench_ps()))
+        return
 
-    log(f"[bench] devices={jax.devices()}")
+    # ---- orchestrator: every section in its own subprocess ----
     extra = {}
 
-    # continuity config (same protocol as r03, feeds the regression gate);
-    # degrade to gpt2-small rather than abort on a smaller-HBM device
-    try:
-        gpt = bench_gpt("gpt2-medium", args.steps, args.warmup, args.batch,
-                        args.seq, accum=args.accum)
-    except Exception as e:
-        log(f"[gpt] gpt2-medium failed ({str(e)[:150]}); trying gpt2-small")
-        gpt = bench_gpt("gpt2-small", args.steps, args.warmup, args.batch,
-                        args.seq, accum=args.accum)
+    # continuity config (same protocol as r03/r04, feeds the regression
+    # gate)
+    common = ["--steps", str(args.steps), "--warmup", str(args.warmup),
+              "--batch", str(args.batch), "--seq", str(args.seq),
+              "--accum", str(args.accum)]
+    gpt = _run_section(["--section", "gpt"] + common,
+                       timeout_s=3600, tag="gpt")
+    if "tokens_per_sec_per_chip" not in gpt:
+        log(f"[gpt] gpt2-medium failed ({gpt.get('error', '?')[:150]}); "
+            f"retrying gpt2-small in a fresh subprocess")
+        small = _run_section(
+            ["--section", "gpt", "--gpt-config", "gpt2-small"] + common,
+            timeout_s=3600, tag="gpt-small")
+        if "tokens_per_sec_per_chip" in small:
+            small["fallback_from"] = gpt.get("error", "gpt2-medium failed")
+            gpt = small
     extra["gpt"] = gpt
-    headline = gpt
+    headline = gpt if "tokens_per_sec_per_chip" in gpt else None
 
     if not args.no_13b:
-        # BASELINE-class config: memory-pressured 1.3B where remat +
-        # bf16 optimizer slots actually bite (VERDICT r3 weak #1).
-        # Ladder: dots remat compiles like the (proven) medium program;
-        # full remat is the memory-safest but has crashed the remote
-        # compile helper; gpt2-large is the graceful floor.
-        # batch=1 first: the XLA memory-pressure solver is the compile
-        # bottleneck at 24 layers near the HBM edge — loosest memory
-        # compiles fastest (L=2 experiment: ~5 min; tight configs 30+)
-        ladder = [("gpt3-1.3b", dict(batch=1, seq=2048, accum=1,
-                                     remat="full", opt_dtype="bfloat16")),
-                  ("gpt3-1.3b", dict(batch=2, seq=2048, accum=1,
-                                     remat="full", opt_dtype="bfloat16")),
-                  ("gpt2-large", dict(batch=8, seq=1024, accum=2,
-                                      remat="dots", opt_dtype="bfloat16"))]
         errors = []
-        for name, kw in ladder:
-            try:
-                gpt13 = bench_gpt(name, max(args.steps // 2, 5),
-                                  args.warmup, **kw)
-                gpt13["fallbacks_tried"] = errors
-                extra["gpt_1p3b"] = gpt13
-                headline = gpt13
+        for i, (name, kw) in enumerate(LADDER_13B):
+            r = _run_section(["--section", "rung", "--rung", str(i),
+                              "--steps", str(args.steps),
+                              "--warmup", str(args.warmup)],
+                             timeout_s=3900, tag=f"rung{i}:{name}")
+            if "tokens_per_sec_per_chip" in r:
+                r["fallbacks_tried"] = errors
+                extra["gpt_1p3b"] = r
+                headline = r
                 break
-            except Exception as e:
-                log(f"[gpt] {name} {kw['remat']} failed: {str(e)[:150]}")
-                errors.append(f"{name}/{kw['remat']}: {str(e)[:120]}")
+            errors.append({"rung": f"{name} {kw}", **r})
+            log(f"[rung{i}] failed: {r.get('error', '?')[:200]}")
         else:
-            extra["gpt_1p3b"] = {"error": "; ".join(errors)[:400]}
+            extra["gpt_1p3b"] = {"error": "all rungs failed",
+                                 "rungs": errors}
 
     if not args.no_flash_micro:
-        try:
-            fm = bench_flash_vs_xla()
-            if fm:
-                extra["flash_vs_xla"] = fm
-        except Exception as e:  # pragma: no cover
-            extra["flash_vs_xla"] = {"error": str(e)[:200]}
-
+        fm = _run_section(["--section", "flash"], timeout_s=1500,
+                          tag="flash")
+        if fm != {"skipped": True}:
+            extra["flash_vs_xla"] = fm
     if not args.no_resnet:
-        extra["resnet"] = _resnet_subprocess()
+        extra["resnet"] = _run_section(["--section", "resnet"],
+                                       timeout_s=1500, tag="resnet")
+    if not args.no_ps:
+        extra["ps"] = _run_section(["--section", "ps"],
+                                   timeout_s=600, tag="ps")
 
     # ---- regression gate: >5% drop vs any prior round fails the bench
     best = prior_best()
@@ -382,6 +558,13 @@ def main():
     extra["regression_gate"] = {
         "prior_best": {f"{k[0]}@b{k[1]}s{k[2]}": v for k, v in best.items()},
         "regression": regression}
+
+    if headline is None:
+        print(json.dumps({
+            "metric": "GPT tokens/sec/chip", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "regression": regression, "extra": extra}))
+        sys.exit(1)
 
     vs_baseline = headline["mfu"] / headline["target_mfu"]
     print(json.dumps({

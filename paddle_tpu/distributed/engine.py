@@ -55,6 +55,87 @@ DATA_AXES = ("dp", "sharding", "ep")   # axes that split the batch
 ALL_AXES = ("dp", "pp", "sharding", "sep", "ep", "mp")
 
 
+def _1f1b_schedule(pp, num_micro):
+    """Static 1F1B tick grid (host-side simulation of the reference's
+    forward_backward_pipeline state machine, pipeline_parallel.py:81).
+
+    Returns (fwd, bwd): int32 arrays [T, pp] where fwd[t, i] is the
+    microbatch stage i runs forward at tick t (-1 = idle), same for bwd.
+    Invariants encoded:
+      - stage i never holds more than (pp - i) in-flight microbatches
+        (the 1F1B memory bound; stage 0 peaks at pp, the last at 1)
+      - activations/cotangents travel between stages via ppermute, so a
+        dependency must be satisfied in a strictly earlier tick — except
+        the last stage, whose backward may consume its own same-tick
+        forward output (fwd runs before bwd inside a tick)
+    """
+    M = num_micro
+    fwd_done = [[False] * M for _ in range(pp)]
+    bwd_done = [[False] * M for _ in range(pp)]
+    fwd_next = [0] * pp
+    bwd_next = [0] * pp
+    fwd_rows, bwd_rows = [], []
+    for _ in range(4 * (M + pp) + 8):
+        if all(b >= M for b in bwd_next):
+            break
+        fwd_t = [-1] * pp
+        bwd_t = [-1] * pp
+        for i in range(pp):
+            m = fwd_next[i]
+            if m < M and (m - bwd_next[i]) < (pp - i) and \
+                    (i == 0 or fwd_done[i - 1][m]):
+                fwd_t[i] = m
+        for i in range(pp):
+            m = bwd_next[i]
+            if m < M:
+                if i == pp - 1:
+                    ok = fwd_done[i][m] or fwd_t[i] == m
+                else:
+                    ok = bwd_done[i + 1][m]
+                if ok:
+                    bwd_t[i] = m
+        for i in range(pp):
+            if fwd_t[i] >= 0:
+                fwd_done[i][fwd_t[i]] = True
+                fwd_next[i] += 1
+            if bwd_t[i] >= 0:
+                bwd_done[i][bwd_t[i]] = True
+                bwd_next[i] += 1
+        fwd_rows.append(fwd_t)
+        bwd_rows.append(bwd_t)
+    else:  # pragma: no cover
+        raise AssertionError(f"1f1b schedule did not converge pp={pp} M={M}")
+    fwd = np.asarray(fwd_rows, np.int32)
+    bwd = np.asarray(bwd_rows, np.int32)
+    _check_mailboxes(pp, fwd, bwd)
+    return fwd, bwd
+
+
+def _check_mailboxes(pp, fwd, bwd):
+    """The device code gives each stage ONE sticky mailbox per direction
+    (an activation sent at tick t is readable from t+1 until the sender
+    sends again).  Assert the schedule never needs more: a second send
+    must not arrive before the first was consumed."""
+    T = fwd.shape[0]
+    for arr, src_of, dst_of in ((fwd, lambda i: i - 1, lambda i: i + 1),
+                                (bwd, lambda i: i + 1, lambda i: i - 1)):
+        for i in range(pp):
+            j = dst_of(i)
+            if not (0 <= j < pp):
+                continue
+            pending = None   # micro sent by i, not yet consumed by j
+            for t in range(T):
+                if pending is not None and arr[t][j] == pending[0] \
+                        and t > pending[1]:
+                    pending = None
+                if arr[t][i] >= 0:
+                    assert pending is None, (
+                        f"mailbox overflow: stage {i} sends micro "
+                        f"{arr[t][i]} at tick {t} before stage {j} "
+                        f"consumed micro {pending[0]}")
+                    pending = (arr[t][i], t)
+
+
 def _psum_varying(x, axes=ALL_AXES):
     """psum ``x`` over exactly the mesh axes it is device-varying on.
 
@@ -92,12 +173,38 @@ class EngineConfig:
     # math still runs in fp32 — cutting steady state from 14 to 8
     # bytes/param so GPT-1.3B-class models fit one 16 GB chip
     opt_dtype: str = "float32"
+    # keep a separate master-weight slot (the reference Adam's
+    # multi_precision).  None = auto: a master is stored only when
+    # opt_dtype differs from the model dtype — when they match, the param
+    # IS the master bit-for-bit and a second copy buys nothing (2 fewer
+    # bytes/param: the difference between GPT-1.3B-class models fitting
+    # one chip's HBM or not)
+    master_weights: bool = None
+    # fp32 working-set bound (in elements) for the optimizer update:
+    # chunks larger than this run window-by-window under lax.map so peak
+    # HLO-temp memory stays O(window) instead of O(largest leaf) — a
+    # 400M-element FFN leaf otherwise materializes 1.5 GB fp32 temps
+    opt_update_window: int = 1 << 24
+
+    # pipeline schedule (reference: pipeline_parallel.py forward_backward_
+    # pipeline vs the interleaved/GPipe variants; DistributedStrategy
+    # pipeline_configs["schedule_mode"]):
+    #   "1f1b"  — memory-bounded: each stage holds at most (pp - stage)
+    #             in-flight microbatch activations; backward ticks are
+    #             interleaved with forward ticks (hand-scheduled vjp)
+    #   "gpipe" — fill-then-drain: all num_microbatches activations live
+    #             until AD's reverse pass (simplest; O(num_micro) memory)
+    pipeline_schedule: str = "1f1b"
 
     def __post_init__(self):
         if self.opt_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"opt_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.opt_dtype!r}")
+        if self.pipeline_schedule not in ("1f1b", "gpipe"):
+            raise ValueError(
+                f"pipeline_schedule must be '1f1b' or 'gpipe', got "
+                f"{self.pipeline_schedule!r}")
 
 
 class HybridEngine:
@@ -170,6 +277,13 @@ class HybridEngine:
             "lnf_g": P(None), "lnf_b": P(None),
         }
 
+    def _use_1f1b(self):
+        """The 1F1B path serves pp>1 tied-embedding dense models; MoE and
+        untied heads fall back to the GPipe tick loop (still correct,
+        O(num_micro) activation memory)."""
+        return (self.pp > 1 and self.ec.pipeline_schedule == "1f1b"
+                and not self.cfg.moe_experts and self.cfg.tie_embeddings)
+
     # ----------------------------------------------------- ZeRO-3 gathering
     def _z3(self):
         return self.ec.zero_stage >= 3 and self.zr > 1
@@ -202,10 +316,44 @@ class HybridEngine:
             wte = self._z3_gather_leaf(wte, self.param_specs()["wte"])
         return wte
 
-    def _opt_chunk(self, leaf_shape, dtype=jnp.float32):
-        n = int(np.prod(leaf_shape))
-        chunk = -(-n // self.zr)  # ceil
-        return chunk
+    # Slot storage geometry: each rank's flat chunk is padded to a multiple
+    # of _SLOT_LANE and stored as [..., rows, _SLOT_LANE].  The trailing
+    # 2-d block keeps a dense TPU tiling — a trailing [1, chunk] bf16
+    # array gets sublane-pair tiling (2, 1) with the pair dim unfilled,
+    # silently DOUBLING its HBM footprint (measured: 17.16 GiB of step
+    # arguments for GPT-1.3B where 9.8 GiB were designed).
+    _SLOT_LANE = 512
+
+    def _chunk_elems(self, n, z3=False):
+        """Per-rank flat chunk length for an n-element leaf (lane-padded).
+        z3 leaves are already sharded — no zr division."""
+        c = n if z3 else -(-n // self.zr)
+        return -(-c // self._SLOT_LANE) * self._SLOT_LANE
+
+    def _adam_window(self, C):
+        """Largest lane-multiple window <= opt_update_window that divides
+        the C-element chunk evenly (C == window means: update in one
+        shot).  Falls back to one shot when C only factors into too many
+        windows — GPT dims are power-of-two rich, so in practice the
+        split is 2^k."""
+        Wmax = max(int(self.ec.opt_update_window), self._SLOT_LANE)
+        if C <= Wmax:
+            return C
+        rows = C // self._SLOT_LANE
+        k = -(-C // Wmax)
+        while k <= min(rows, 256) and rows % k:
+            k += 1
+        if k > min(rows, 256):
+            return C
+        return C // k
+
+    def _has_master(self):
+        if self.ec.master_weights is not None:
+            return self.ec.master_weights
+        return self.ec.opt_dtype != self.cfg.dtype
+
+    def _slot_keys(self):
+        return ("m", "v", "master") if self._has_master() else ("m", "v")
 
     def batch_spec(self):
         return P(DATA_AXES, "sep")
@@ -247,11 +395,11 @@ class HybridEngine:
 
     def _opt_leaf_spec(self, spec):
         names = self._leaf_axes(spec)
-        # slot layout [pp?, mp-or-ep?, zr, chunk]; no leaf carries both
-        # mp and ep (experts are not tensor-parallel)
+        # slot layout [pp?, mp-or-ep?, zr, rows, lane]; no leaf carries
+        # both mp and ep (experts are not tensor-parallel)
         second = "mp" if "mp" in names else ("ep" if "ep" in names else None)
-        s = P("pp" if "pp" in names else None, second, "sharding", None)
-        return {"m": s, "v": s, "master": s}
+        s = P("pp" if "pp" in names else None, second, "sharding", None, None)
+        return {k: s for k in self._slot_keys()}
 
     def opt_specs(self):
         specs = self.param_specs()
@@ -262,39 +410,48 @@ class HybridEngine:
                 is_leaf=lambda x: isinstance(x, P)),
         }
 
+    def _slot_shape(self, chunk):
+        return (1, 1, 1, chunk // self._SLOT_LANE, self._SLOT_LANE)
+
+    def _param_chunk(self, p_local, z3, dtype=None):
+        """This rank's lane-padded flat chunk of a param leaf."""
+        n = int(np.prod(p_local.shape))
+        chunk = self._chunk_elems(n, z3)
+        flat = p_local.reshape(-1)
+        if dtype is not None:
+            flat = flat.astype(dtype)
+        if z3:
+            return jnp.pad(flat, (0, chunk - n))
+        flat = jnp.pad(flat, (0, self.zr * chunk - n))
+        # local zr axis is mapped over 'sharding': pick own row (axis_index
+        # even at zr==1 so the result is sharding-varying, matching the
+        # opt spec's 'sharding' entry under check_vma)
+        idx = jax.lax.axis_index("sharding")
+        return jax.lax.dynamic_slice_in_dim(
+            flat.reshape(self.zr, chunk), idx, 1, axis=0)[0]
+
     def _init_opt(self, params):
         """Opt state is built per LOCAL param shard (ZeRO chunks partition
-        the local flattened param).  Leaf layout: [pp?, mp?, zr, chunk]."""
+        the local flattened param).  Leaf layout: [pp?, mp?, zr, rows,
+        lane] (see _SLOT_LANE)."""
         from jax import shard_map
 
-        zr = self.zr
         specs = self.param_specs()
-
         odt = self._opt_jdt()
+        has_master = self._has_master()
 
         def init_local(params_local):
             def build(p_local, spec):
+                z3 = self._z3() and "sharding" in self._leaf_axes(spec)
                 n = int(np.prod(p_local.shape))
-                if self._z3() and "sharding" in self._leaf_axes(spec):
-                    # stage-3 leaf: the local param IS this rank's shard —
-                    # its flat value is the master chunk as-is (already
-                    # sharding-varying, matching the opt spec)
-                    z = jnp.zeros((1, 1, 1, n), odt)
-                    return {"m": z, "v": z,
-                            "master": p_local.reshape(1, 1, 1, n)
-                                             .astype(odt)}
-                chunk = -(-n // zr)
-                flat = jnp.pad(p_local.reshape(-1).astype(odt),
-                               (0, zr * chunk - n))
-                local = flat.reshape(zr, chunk)
-                # local zr axis is mapped over 'sharding': pick own row
-                # (axis_index even at zr==1 so the result is sharding-varying,
-                # matching the opt spec's 'sharding' entry under check_vma)
-                idx = jax.lax.axis_index("sharding")
-                mine = jax.lax.dynamic_slice_in_dim(local, idx, 1, axis=0)
-                z = jnp.zeros((1, 1, 1, chunk), odt)
-                return {"m": z, "v": z,
-                        "master": mine.reshape(1, 1, 1, chunk)}
+                chunk = self._chunk_elems(n, z3)
+                shape = self._slot_shape(chunk)
+                z = jnp.zeros(shape, odt)
+                slot = {"m": z, "v": z}
+                if has_master:
+                    slot["master"] = self._param_chunk(
+                        p_local, z3, odt).reshape(shape)
+                return slot
 
             return jax.tree_util.tree_map(build, params_local, specs)
 
@@ -320,15 +477,17 @@ class HybridEngine:
         specs = self.param_specs()
         zr = self.zr
 
+        odt = self._opt_jdt()
+
         def local(slots, params_local):
             def un(slot_leaf, p_local, spec):
-                flat = slot_leaf[0, 0, 0]
+                flat = slot_leaf[0, 0, 0].reshape(-1)
                 if not (self._z3() and "sharding" in self._leaf_axes(spec)):
                     # scatter-own-chunk + psum = the varying→invariant
                     # all_gather (same idiom as the step's param rebuild)
                     chunk = flat.shape[0]
-                    full = jnp.zeros((zr * chunk,), flat.dtype)
                     idx = jax.lax.axis_index("sharding")
+                    full = jnp.zeros((zr * chunk,), flat.dtype)
                     full = jax.lax.dynamic_update_slice(
                         full, flat, (idx * chunk,))
                     flat = jax.lax.psum(full, "sharding")
@@ -336,12 +495,16 @@ class HybridEngine:
                 return flat[:n].reshape(p_local.shape)
 
             is_slot = lambda x: isinstance(x, dict) and \
-                set(x) == {"m", "v", "master"}
+                set(x) == set(self._slot_keys())
             out = {}
-            for name in ("m", "v", "master"):
+            for name in self._slot_keys():
                 out[name] = jax.tree_util.tree_map(
                     lambda s, p, sp, name=name: un(s[name], p, sp),
                     slots, params_local, specs, is_leaf=is_slot)
+            if not self._has_master():
+                # master-less mode: the param IS the master bit-for-bit
+                out["master"] = jax.tree_util.tree_map(
+                    lambda p: p.astype(odt), params_local)
             return out
 
         out_specs = {k: specs for k in ("m", "v", "master")}
@@ -364,20 +527,25 @@ class HybridEngine:
 
         def local(canon):
             def chunk(val, spec):
+                z3 = self._z3() and "sharding" in self._leaf_axes(spec)
                 n = int(np.prod(val.shape))
-                if self._z3() and "sharding" in self._leaf_axes(spec):
-                    return val.reshape(1, 1, 1, n).astype(odt)
-                c = -(-n // zr)
+                c = self._chunk_elems(n, z3)
+                shape = self._slot_shape(c)
+                if z3:
+                    return jnp.pad(val.reshape(-1).astype(odt),
+                                   (0, c - n)).reshape(shape)
                 flat = jnp.pad(val.reshape(-1).astype(odt),
                                (0, zr * c - n))
                 idx = jax.lax.axis_index("sharding")
                 mine = jax.lax.dynamic_slice_in_dim(
                     flat.reshape(zr, c), idx, 1, axis=0)
-                return mine.reshape(1, 1, 1, c)
+                return mine.reshape(shape)
 
             def build(m, v, master, spec):
-                return {"m": chunk(m, spec), "v": chunk(v, spec),
-                        "master": chunk(master, spec)}
+                slot = {"m": chunk(m, spec), "v": chunk(v, spec)}
+                if self._has_master():
+                    slot["master"] = chunk(master, spec)
+                return slot
 
             return jax.tree_util.tree_map(
                 build, canon["m"], canon["v"], canon["master"], specs)
@@ -420,10 +588,12 @@ class HybridEngine:
 
     # ------------------------------------------------------- forward pieces
     def _embed(self, params, tokens):
+        return self._embed_core(self._wte(params), params["wpe"], tokens)
+
+    def _embed_core(self, wte, wpe, tokens):
         """Vocab-parallel embedding + position embedding.
-        tokens: [b, s_local]; wte local: [V/mp, D]."""
+        tokens: [b, s_local]; wte local (gathered over z3): [V/mp, D]."""
         cfg, mp, sep = self.cfg, self.mp, self.sep
-        wte = self._wte(params)
         vpp = cfg.vocab_size // mp
         mp_idx = jax.lax.axis_index("mp") if mp > 1 else 0
         local_ids = tokens - mp_idx * vpp
@@ -431,12 +601,14 @@ class HybridEngine:
         safe = jnp.clip(local_ids, 0, vpp - 1)
         emb = jnp.take(wte, safe, axis=0)
         emb = jnp.where(in_shard[..., None], emb, 0.0)
-        if mp > 1:
-            emb = jax.lax.psum(emb, "mp")
+        # vma-driven: real psum at mp>1, free varying→invariant type cast
+        # at mp==1 (a size-1 axis still marks values mp-varying, which
+        # would poison fixed-carry scans downstream)
+        emb = _psum_varying(emb, ("mp",))
         s_local = tokens.shape[1]
         sep_idx = jax.lax.axis_index("sep") if sep > 1 else 0
         pos = jax.lax.dynamic_slice_in_dim(
-            params["wpe"], sep_idx * s_local, s_local, axis=0)
+            wpe, sep_idx * s_local, s_local, axis=0)
         return (emb + pos).astype(self.cfg.jdtype())
 
     def _attention(self, q, k, v):
@@ -496,8 +668,7 @@ class HybridEngine:
         attn = self._attention(q, k, v)          # [B, H_local, s_local, hd]
         attn = attn.transpose(0, 2, 1, 3).reshape(B, s_local, H_local * hd)
         proj = jnp.einsum("bse,ed->bsd", attn, bp["proj_w"])
-        if mp > 1:
-            proj = jax.lax.psum(proj, "mp")
+        proj = _psum_varying(proj, ("mp",))
         x = x + _dropout(proj + bp["proj_b"], cfg.dropout, k_attn)
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
@@ -515,8 +686,7 @@ class HybridEngine:
         h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
         h = jax.nn.gelu(h, approximate=True)
         down = jnp.einsum("bsf,fd->bsd", h, bp["down_w"])
-        if mp > 1:
-            down = jax.lax.psum(down, "mp")
+        down = _psum_varying(down, ("mp",))
         return x + _dropout(down + bp["down_b"], cfg.dropout, k_ffn), \
             jnp.zeros((), jnp.float32)
 
@@ -560,6 +730,13 @@ class HybridEngine:
         return {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
                 "wte": self._wte(params)}
 
+    # fp32 logits-block budget for the loss head (elements).  Above it the
+    # head runs in sequence chunks under lax.map + jax.checkpoint so the
+    # [b, s, V] fp32 logits/softmax never fully materialize — at GPT-1.3B
+    # (V=50304, s=2048) the un-chunked head holds >1.6 GB of fp32 per
+    # microbatch plus softmax residuals for backward.
+    _CE_BLOCK_ELEMS = 1 << 26
+
     def _loss_head(self, hp, x, labels):
         """Final LN + tied-embedding logits + vocab-parallel CE.
         hp: head params (see _head_params); x: [b, s_local, D];
@@ -569,15 +746,40 @@ class HybridEngine:
         from .mp_layers import parallel_cross_entropy
 
         x = _layer_norm(x, hp["lnf_g"], hp["lnf_b"])
-        logits = jnp.einsum("bsd,vd->bsv", x, hp["wte"]).astype(jnp.float32)
-        if mp > 1:
-            loss_tok = parallel_cross_entropy(logits, labels, mp_axis="mp")
-        else:
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            safe = jnp.maximum(labels, 0)
-            loss_tok = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
-        mask = (labels != -100).astype(jnp.float32)
-        return (loss_tok * mask).sum(), mask.sum()
+
+        def ce_chunk(xc, lc):
+            logits = jnp.einsum("bsd,vd->bsv", xc,
+                                hp["wte"]).astype(jnp.float32)
+            if mp > 1:
+                loss_tok = parallel_cross_entropy(logits, lc, mp_axis="mp")
+            else:
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                safe = jnp.maximum(lc, 0)
+                loss_tok = -jnp.take_along_axis(
+                    logp, safe[..., None], -1)[..., 0]
+            mask = (lc != -100).astype(jnp.float32)
+            # de-vary mp: at mp==1 the tied wte is typed mp-varying and
+            # would otherwise mark the loss mp-varying too
+            return _psum_varying((loss_tok * mask).sum(), ("mp",)), \
+                mask.sum()
+
+        b, s, _ = x.shape
+        v_local = hp["wte"].shape[0]
+        nchunk = 1
+        while (b * s * v_local) // nchunk > self._CE_BLOCK_ELEMS \
+                and s % (2 * nchunk) == 0:
+            nchunk *= 2
+        if nchunk == 1:
+            return ce_chunk(x, labels)
+        sc = s // nchunk
+        xc = x.reshape(b, nchunk, sc, x.shape[-1]).transpose(1, 0, 2, 3)
+        lc = labels.reshape(b, nchunk, sc).transpose(1, 0, 2)
+        # checkpoint: backward re-runs the chunk (one extra head matmul)
+        # instead of keeping each chunk's fp32 softmax residuals live
+        s_sum, c_sum = jax.lax.map(
+            jax.checkpoint(lambda a: ce_chunk(*a), prevent_cse=False),
+            (xc, lc))
+        return s_sum.sum(), c_sum.sum()
 
     def _aux_mean(self, aux):
         """Reduce a per-shard MoE aux loss to the global batch value: SUM
@@ -593,6 +795,230 @@ class HybridEngine:
             if name in vma:
                 denom *= size
         return total / denom
+
+    # --------------------------------------------------- 1F1B (hand vjp)
+    def _loss_head_raw(self, hp_raw, y, labels):
+        """_loss_head over UN-gathered head params (z3 wte gather inside,
+        so vjp emits shard-formed wte cotangents directly)."""
+        wte = hp_raw["wte"]
+        if self._z3():
+            wte = self._z3_gather_leaf(wte, self.param_specs()["wte"])
+        return self._loss_head({"lnf_g": hp_raw["lnf_g"],
+                                "lnf_b": hp_raw["lnf_b"], "wte": wte}, y,
+                               labels)
+
+    def _embed_raw(self, wte_raw, wpe, tokens, key):
+        """Embedding over the UN-gathered wte + per-micro embed dropout."""
+        if self._z3():
+            wte_raw = self._z3_gather_leaf(wte_raw,
+                                           self.param_specs()["wte"])
+        x = self._embed_core(wte_raw, wpe, tokens)
+        if key is not None:
+            from ..models.gpt import _dropout
+
+            x = _dropout(x, self.cfg.dropout, key)
+        return x
+
+    def _pipeline_1f1b(self, params, tokens, labels, key=None):
+        """(loss, grads) via the memory-bounded 1F1B pipeline schedule.
+
+        The GPipe tick loop (_local_loss) leaves the backward to AD, so
+        every microbatch's stage input stays live until the reverse scan:
+        O(num_microbatches) activation memory.  Here backward ticks are
+        hand-scheduled (reference: forward_backward_pipeline,
+        pipeline_parallel.py:81): each stage keeps a ring buffer of at
+        most pp saved stage INPUTS, and a backward tick re-runs the stage
+        under jax.vjp from the saved input (stage-granular recompute —
+        the same total compute as remat='full', which is how the
+        BASELINE-class configs run anyway).  Activations ride the forward
+        ppermute ring; cotangents ride the reverse ring.
+
+        The CE denominator (global non-ignored token count) is computed
+        from labels BEFORE the loop, so each microbatch's head cotangent
+        seed (1/total_cnt) is exact and backward can start mid-pipeline.
+
+        Params consumed inside the tick conds are pre-lifted to the full
+        carry vma (see the GPipe note below) AND to the data axes, so
+        per-micro pullbacks accumulate device-local grads without
+        inserting per-tick psums; grads are synced to their param's vma
+        once, after the loop."""
+        cfg, pp = self.cfg, self.pp
+        assert not cfg.moe_experts and cfg.tie_embeddings, \
+            "pipeline_schedule='1f1b' supports tied-embedding dense " \
+            "models (use pipeline_schedule='gpipe' for MoE/untied)"
+        M = self.ec.num_microbatches
+        b, s_local = tokens.shape
+        assert b % M == 0, "local batch must divide microbatches"
+        mb = b // M
+        D = cfg.hidden
+        x_dtype = cfg.jdtype()
+
+        pp_idx = jax.lax.axis_index("pp")
+        fwd_np, bwd_np = _1f1b_schedule(pp, M)
+        fwd_sched = jnp.asarray(fwd_np)
+        bwd_sched = jnp.asarray(bwd_np)
+        T = fwd_np.shape[0]
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+        from ..core.vma import lift_to, lifter, vma_of
+
+        carry_axes = tuple(sorted(set(jax.typeof(tokens).vma) | {"pp"}))
+        lift = lifter(*carry_axes)
+        ltree = lambda t: jax.tree_util.tree_map(lift, t)
+
+        def zlike(p):
+            # grad accumulator: varying over the param's own axes (mp/…)
+            # PLUS the carry axes, so the scan carry type is fixed from
+            # tick 0 and per-micro pullbacks stay psum-free
+            return lift_to(jnp.zeros_like(p),
+                           tuple(sorted(set(vma_of(p)) | set(carry_axes))))
+
+        # global CE denominator, known before the pipeline runs
+        cnt_local = (labels != -100).astype(jnp.float32).sum()
+        denom = jnp.maximum(_psum_varying(cnt_local), 1.0)
+        seed = lift(1.0 / denom)
+
+        blocks_l = ltree(params["blocks"])
+        hp_raw_l = ltree({"lnf_g": params["lnf_g"],
+                          "lnf_b": params["lnf_b"], "wte": params["wte"]})
+        wpe_l = lift(params["wpe"])
+        tok_mb_l = lift(tokens.reshape(M, mb, s_local))
+        lab_mb_l = lift(labels.reshape(M, mb, s_local))
+
+        def stage_fn(bl, x, k):
+            y, _aux = self._stage(bl, x, k)
+            return y
+
+        def zero_act():
+            return lift(jnp.zeros((mb, s_local, D), x_dtype))
+
+        zeros_g_bl = jax.tree_util.tree_map(zlike, params["blocks"])
+        zeros_dhp = {"lnf_g": zlike(params["lnf_g"]),
+                     "lnf_b": zlike(params["lnf_b"]),
+                     "wte": zlike(params["wte"])}
+        zeros_wpe = zlike(params["wpe"])
+        zero = lambda: lift(jnp.zeros((), jnp.float32))
+
+        def tick(carry, t):
+            ring, x_next, ct_next, g_bl, g_hp, g_wpe, loss_sum = carry
+            frow = jax.lax.dynamic_index_in_dim(fwd_sched, t, 0,
+                                                keepdims=False)
+            brow = jax.lax.dynamic_index_in_dim(bwd_sched, t, 0,
+                                                keepdims=False)
+            my_f = jnp.take(frow, pp_idx)
+            my_b = jnp.take(brow, pp_idx)
+            mf = jnp.clip(my_f, 0, M - 1)
+            mbi = jnp.clip(my_b, 0, M - 1)
+            kf = (jax.random.fold_in(key, mf) if key is not None else None)
+            kb = (jax.random.fold_in(key, mbi) if key is not None else None)
+            kef = (jax.random.fold_in(kf, 999983)
+                   if key is not None else None)
+            keb = (jax.random.fold_in(kb, 999983)
+                   if key is not None else None)
+
+            # ---------------- forward tick ----------------
+            def run_fwd(ring, x_next):
+                x0 = jax.lax.cond(
+                    pp_idx == 0,
+                    lambda: lift(self._embed_raw(
+                        hp_raw_l["wte"], wpe_l, tok_mb_l[mf], kef)),
+                    lambda: x_next)
+                y = lift(stage_fn(blocks_l, x0, kf))
+                ring = jax.lax.dynamic_update_index_in_dim(
+                    ring, x0, mf % pp, 0)
+                return y, ring
+
+            y, ring = jax.lax.cond(
+                my_f >= 0, run_fwd, lambda r, xn: (zero_act(), r),
+                ring, x_next)
+
+            # ---------------- backward tick ----------------
+            lab_b = lab_mb_l[mbi]
+            x_saved = jax.lax.dynamic_index_in_dim(ring, mbi % pp, 0,
+                                                   keepdims=False)
+
+            def run_bwd(y, ct_next, g_bl, g_hp, g_wpe, loss_sum):
+                # last stage: build the cotangent from the head's vjp at
+                # this tick's own forward output (the schedule guarantees
+                # my_b == my_f there); other stages take the arrived one
+                def head_ct(y):
+                    (s_m, c_m), pull = jax.vjp(
+                        lambda hp_, y_: self._loss_head_raw(hp_, y_,
+                                                            lab_b),
+                        hp_raw_l, y)
+                    dhp, dy = pull((seed, jnp.zeros_like(c_m)))
+                    return lift(dy), ltree(dhp), lift(s_m)
+
+                def recv_ct(y):
+                    return ct_next, zeros_dhp, zero()
+
+                dy, dhp, s_m = jax.lax.cond(pp_idx == pp - 1, head_ct,
+                                            recv_ct, y)
+                loss_sum = loss_sum + s_m
+                g_hp = jax.tree_util.tree_map(jnp.add, g_hp, dhp)
+                # stage vjp at the saved input (stage-granular recompute)
+                _, pull = jax.vjp(
+                    lambda bl, x: stage_fn(bl, x, kb), blocks_l, x_saved)
+                dbl, dx = pull(dy)
+                g_bl = jax.tree_util.tree_map(jnp.add, g_bl, ltree(dbl))
+                dx = lift(dx)
+
+                # first stage: fold the input cotangent into the
+                # embedding's params instead of sending it further back
+                def emb_bwd(dx):
+                    _, epull = jax.vjp(
+                        lambda w, p: self._embed_raw(w, p, tok_mb_l[mbi],
+                                                     keb),
+                        hp_raw_l["wte"], wpe_l)
+                    dwte, dwpe = epull(dx)
+                    return lift(dwte), lift(dwpe)
+
+                dwte, dwpe = jax.lax.cond(
+                    pp_idx == 0, emb_bwd,
+                    lambda dx: (zeros_dhp["wte"], zeros_wpe), dx)
+                g_hp = {"lnf_g": g_hp["lnf_g"], "lnf_b": g_hp["lnf_b"],
+                        "wte": g_hp["wte"] + dwte}
+                g_wpe = g_wpe + dwpe
+                return dx, g_bl, g_hp, g_wpe, loss_sum
+
+            dx_send, g_bl, g_hp, g_wpe, loss_sum = jax.lax.cond(
+                my_b >= 0, run_bwd,
+                lambda y, c, a, b_, c_, d_: (zero_act(), a, b_, c_, d_),
+                y, ct_next, g_bl, g_hp, g_wpe, loss_sum)
+
+            # sticky mailboxes: latch the arrived value ONLY when the
+            # schedule says the sender was active this tick — an idle
+            # sender's ppermute carries zeros and must not clobber a
+            # not-yet-consumed activation (at pp>=3 the 1F1B in-flight
+            # bound makes stages idle mid-stream; _check_mailboxes proves
+            # one slot per direction is enough)
+            x_arr = jax.lax.ppermute(y, "pp", fwd_perm)
+            ct_arr = jax.lax.ppermute(dx_send, "pp", bwd_perm)
+            x_from = jnp.take(frow, (pp_idx - 1) % pp) >= 0
+            ct_from = jnp.take(brow, (pp_idx + 1) % pp) >= 0
+            x_next = jnp.where(x_from, x_arr, x_next)
+            ct_next = jnp.where(ct_from, ct_arr, ct_next)
+            return (ring, x_next, ct_next, g_bl, g_hp, g_wpe,
+                    loss_sum), None
+
+        ring0 = lift(jnp.zeros((pp, mb, s_local, D), x_dtype))
+        carry0 = (ring0, zero_act(), zero_act(), zeros_g_bl, zeros_dhp,
+                  zeros_wpe, zero())
+        (ring, _, _, g_bl, g_hp, g_wpe, loss_sum), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        grads = {"wte": g_hp["wte"], "wpe": g_wpe, "blocks": g_bl,
+                 "lnf_g": g_hp["lnf_g"], "lnf_b": g_hp["lnf_b"]}
+
+        def sync(g, p):
+            extra = tuple(a for a in jax.typeof(g).vma
+                          if a not in jax.typeof(p).vma)
+            return jax.lax.psum(g, extra) if extra else g
+
+        grads = jax.tree_util.tree_map(sync, grads, params)
+        loss = _psum_varying(loss_sum) / denom
+        return loss, grads
 
     # ---------------------------------------------------------- loss (SPMD)
     def _local_loss(self, params, tokens, labels, key=None):
@@ -702,7 +1128,10 @@ class HybridEngine:
     def _step_local(self, params, opt_state, tokens, labels, lr, seed):
         ec, zr = self.ec, self.zr
         accum = ec.accum_steps
-        grad_fn = jax.value_and_grad(self._local_loss)
+        if self._use_1f1b():
+            grad_fn = self._pipeline_1f1b
+        else:
+            grad_fn = jax.value_and_grad(self._local_loss)
         if self.cfg.dropout > 0.0:
             # distinct masks per data shard (fold each data-axis coord),
             # IDENTICAL masks across mp (never folded) — the reference's
@@ -749,11 +1178,12 @@ class HybridEngine:
             chunks = []
             for g, z3 in zip(flat_g, z3_leaf):
                 dt = dtype or g.dtype
-                if z3:
-                    chunks.append(g.reshape(-1).astype(dt))
-                    continue
                 n = int(np.prod(g.shape))
-                chunk = -(-n // zr)
+                chunk = self._chunk_elems(n, z3)
+                if z3:
+                    chunks.append(jnp.pad(g.reshape(-1).astype(dt),
+                                          (0, chunk - n)))
+                    continue
                 gf = jnp.pad(g.reshape(-1).astype(dt),
                              (0, zr * chunk - n))
                 chunks.append(jax.lax.dynamic_slice_in_dim(
@@ -784,7 +1214,7 @@ class HybridEngine:
 
             def chunk_zero(p, z3):
                 n = int(np.prod(p.shape))
-                size = n if z3 else -(-n // zr)
+                size = self._chunk_elems(n, z3)
                 vma = tuple(sorted(set(jax.typeof(p).vma) | {"sharding"}))
                 return jax.lax.pcast(jnp.zeros((size,), jnp.float32), vma,
                                      to="varying")
@@ -820,54 +1250,102 @@ class HybridEngine:
         b1, b2 = ec.beta1, ec.beta2
         stepf = step.astype(jnp.float32)
         odt = self._opt_jdt()
+        has_master = self._has_master()
+        bc1 = 1 - jnp.power(b1, stepf)
+        bc2 = 1 - jnp.power(b2, stepf)
         for path, p, slots, g, z3 in zip(paths, flat_p, flat_slots, g_chunks,
                                          z3_leaf):
-            # math in fp32 regardless of slot storage dtype
-            m_loc = slots["m"][0, 0, 0].astype(jnp.float32)   # [chunk]
-            v_loc = slots["v"][0, 0, 0].astype(jnp.float32)
-            w_loc = slots["master"][0, 0, 0].astype(jnp.float32)
-            g = g.astype(jnp.float32)
-            m = b1 * m_loc + (1 - b1) * g
-            v = b2 * v_loc + (1 - b2) * g * g
-            m_hat = m / (1 - jnp.power(b1, stepf))
-            v_hat = v / (1 - jnp.power(b2, stepf))
-            upd = m_hat / (jnp.sqrt(v_hat) + ec.eps)
             decay = ec.weight_decay
-            if decay and ("ln" not in path.split("/")[-1]) and \
-                    not path.endswith("_b"):
-                upd = upd + decay * w_loc
-            w_new = w_loc - lr * upd
+            decay_on = bool(decay) and \
+                ("ln" not in path.split("/")[-1]) and \
+                not path.endswith("_b")
+            w_store = (slots["master"] if has_master
+                       else self._param_chunk(p, z3))
+
+            def adam_win(g_w, m_w, v_w, w_w, p_dtype=p.dtype,
+                         decay_on=decay_on):
+                """One window of the update — math in fp32 regardless of
+                storage dtype; returns storage-dtype results."""
+                gf = g_w.astype(jnp.float32)
+                m = b1 * m_w.astype(jnp.float32) + (1 - b1) * gf
+                v = b2 * v_w.astype(jnp.float32) + (1 - b2) * gf * gf
+                wf = w_w.astype(jnp.float32)
+                upd = (m / bc1) / (jnp.sqrt(v / bc2) + ec.eps)
+                if decay_on:
+                    upd = upd + decay * wf
+                w_new = wf - lr * upd
+                out = (m.astype(odt), v.astype(odt),
+                       w_new.astype(p_dtype))
+                if has_master:
+                    out = out + (w_new.astype(odt),)
+                return out
+
+            g_f = g.reshape(-1)
+            m_f = slots["m"].reshape(-1)
+            v_f = slots["v"].reshape(-1)
+            w_f = w_store.reshape(-1)
+            C = g_f.shape[0]
+            W = self._adam_window(C)
+            if W == C:
+                outs = adam_win(g_f, m_f, v_f, w_f)
+            else:
+                # window the chunk with a fori_loop of dynamic slices,
+                # updating the flat buffers IN PLACE: fp32 temps stay
+                # O(window) and — unlike a pad+reshape+lax.map — no
+                # stacked copy of g/m/v/w ever materializes (measured:
+                # 6 x 768 MB of copies for a 302M-element leaf)
+                w_out0 = (w_f if w_f.dtype == p.dtype
+                          else jnp.zeros((C,), p.dtype))
+                bufs0 = (m_f, v_f, w_out0) + ((w_f,) if has_master else ())
+
+                def win_body(i, bufs):
+                    # reads come from the CARRY (windows are disjoint and
+                    # each is read before it is written), so the original
+                    # arrays are not loop operands and XLA can update the
+                    # buffers genuinely in place
+                    lo = i * W
+                    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, lo, W)
+                    w_src = bufs[3] if has_master else bufs[2]
+                    new = adam_win(sl(g_f), sl(bufs[0]), sl(bufs[1]),
+                                   sl(w_src))
+                    return tuple(
+                        jax.lax.dynamic_update_slice_in_dim(b, n, lo, 0)
+                        for b, n in zip(bufs, new))
+
+                outs = jax.lax.fori_loop(0, C // W, win_body, bufs0)
+            m_new, v_new, w_param = outs[0], outs[1], outs[2]
+
             if z3:
                 # stage-3: the param stays sharded — the updated chunk IS
-                # the new local param (no allgather; the forward gathers JIT)
-                new_p = w_new.reshape(p.shape).astype(p.dtype)
+                # the new local param (no allgather; the forward gathers
+                # JIT).  Slice off the lane padding.
+                n = int(np.prod(p.shape))
+                new_p = w_param[:n].reshape(p.shape)
             elif zr == 1:
                 # chunk == full param: psum over the size-1 axis is the
-                # type-level varying→invariant cast and compiles to a
-                # copy — the scatter-into-zeros path below materializes
-                # an extra full-width fp32 temp PER LEAF and breaks the
-                # elementwise fusion (the difference between GPT-1.3B
-                # fitting one chip or blowing HBM by 9G at compile)
-                full = jax.lax.psum(w_new, "sharding")
-                new_p = full.reshape(p.shape).astype(p.dtype)
+                # type-level varying→invariant cast and compiles to a copy
+                n = int(np.prod(p.shape))
+                new_p = jax.lax.psum(w_param, "sharding")[:n].reshape(
+                    p.shape)
             else:
-                # rebuild the full fp32 param: scatter own chunk into zeros
-                # and psum over 'sharding' (psum is the only
+                # rebuild the full param (in its own dtype — the chunks
+                # are disjoint, so combining via scatter+psum adds only
+                # zeros and is exact in any dtype): psum is the only
                 # varying→invariant cast, so this is the type-correct
-                # all_gather)
-                full = jnp.zeros((zr * w_new.shape[0],), jnp.float32)
+                # all_gather
+                full = jnp.zeros((zr * C,), w_param.dtype)
                 full = jax.lax.dynamic_update_slice(
-                    full, w_new, (zr_idx * w_new.shape[0],))
+                    full, w_param, (zr_idx * C,))
                 full = jax.lax.psum(full, "sharding")
                 n = int(np.prod(p.shape))
-                new_p = full[:n].reshape(p.shape).astype(p.dtype)
+                new_p = full[:n].reshape(p.shape)
             new_flat_p.append(new_p)
-            shape4 = slots["m"].shape
-            new_flat_slots.append({
-                "m": m.reshape(shape4).astype(odt),
-                "v": v.reshape(shape4).astype(odt),
-                "master": w_new.reshape(shape4).astype(odt),
-            })
+            shape5 = slots["m"].shape
+            slot_new = {"m": m_new.reshape(shape5),
+                        "v": v_new.reshape(shape5)}
+            if has_master:
+                slot_new["master"] = outs[3].reshape(shape5)
+            new_flat_slots.append(slot_new)
 
         new_params = jax.tree_util.tree_unflatten(treedef, new_flat_p)
         new_slots = jax.tree_util.tree_unflatten(treedef, new_flat_slots)

@@ -1,0 +1,175 @@
+"""1F1B pipeline schedule tests (VERDICT r4 item 2).
+
+Reference parity target: forward_backward_pipeline
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:81) —
+the memory-bounded schedule whose live activations are O(pp), not
+O(num_microbatches).
+
+Covers: the static schedule's invariants (incl. the single-slot mailbox
+property the device code depends on — at pp>=3 stages go idle mid-stream
+and a naive mailbox gets clobbered with zeros), loss parity 1f1b-vs-gpipe
+at pp>=3 where the mailbox actually matters, hybrid parity, and the
+activation-memory bound as num_microbatches doubles.
+"""
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from paddle_tpu.distributed.engine import (EngineConfig, HybridEngine,
+                                           _1f1b_schedule)
+from paddle_tpu.models.gpt import GPTConfig
+
+CFG = GPTConfig(vocab_size=256, max_seq_len=64, hidden=64, num_layers=4,
+                num_heads=4, ffn_hidden=128, dtype="float32",
+                use_flash=False, remat="nothing")
+
+
+def _batch(bs=8, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, CFG.vocab_size, (bs, seq)).astype(np.int32)
+    labels = np.concatenate([tokens[:, 1:], np.full((bs, 1), -100)],
+                            axis=1).astype(np.int32)
+    return tokens, labels
+
+
+def _run(engine, n=3, bs=8):
+    params, opt = engine.init(seed=0)
+    tokens, labels = _batch(bs)
+    losses = []
+    for _ in range(n):
+        params, opt, loss = engine.step(params, opt, tokens, labels,
+                                        lr=1e-3)
+        losses.append(float(loss))
+    return losses, engine.gather_params(params)
+
+
+class TestSchedule:
+    @pytest.mark.parametrize("pp,M", [(2, 2), (2, 4), (2, 8), (3, 3),
+                                      (3, 6), (4, 4), (4, 8), (4, 16),
+                                      (8, 8), (8, 32)])
+    def test_invariants(self, pp, M):
+        f, b = _1f1b_schedule(pp, M)   # raises on mailbox overflow
+        T = f.shape[0]
+        for i in range(pp):
+            assert sorted(m for m in f[:, i] if m >= 0) == list(range(M))
+            assert sorted(m for m in b[:, i] if m >= 0) == list(range(M))
+        # 1F1B memory bound: stage i holds <= pp - i in flight
+        for i in range(pp):
+            infl = peak = 0
+            for t in range(T):
+                infl += int(f[t, i] >= 0) - int(b[t, i] >= 0)
+                peak = max(peak, infl)
+            assert peak <= pp - i
+        # dependencies ride one-tick ppermutes
+        tick = lambda a, i, m: int(np.where(a[:, i] == m)[0][0])
+        for m in range(M):
+            for i in range(1, pp):
+                assert tick(f, i, m) > tick(f, i - 1, m)
+            for i in range(pp - 1):
+                assert tick(b, i, m) > tick(b, i + 1, m)
+            # last stage pairs bwd with its own same-tick fwd
+            assert tick(b, pp - 1, m) == tick(f, pp - 1, m)
+
+    def test_stages_go_idle_at_pp3(self):
+        """The case that distinguishes a sticky mailbox from a naive one:
+        at pp>=3 a stage is fwd-idle mid-stream while its successor has
+        not yet consumed the last activation."""
+        f, _ = _1f1b_schedule(3, 6)
+        sent = {int(np.where(f[:, 0] == m)[0][0]): m for m in range(6)}
+        consumed = {m: int(np.where(f[:, 1] == m)[0][0]) for m in range(6)}
+        assert any(consumed[m] > t + 1 for t, m in sent.items()), \
+            "expected a >1-tick mailbox dwell at pp=3"
+
+
+class TestParity:
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        eng = HybridEngine(CFG, devices=jax.devices()[:1])
+        return _run(eng)
+
+    def test_pp4_matches_single_device(self, baseline):
+        """pp=4 exercises mid-stream idle ticks (the pp>=3 mailbox case
+        pp=2 coincidentally never hits)."""
+        eng = HybridEngine(CFG, pp=4, devices=jax.devices()[:4],
+                           engine_cfg=EngineConfig(num_microbatches=8))
+        losses, params = _run(eng)
+        np.testing.assert_allclose(losses, baseline[0], atol=2e-4,
+                                   rtol=1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(baseline[1]),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
+
+    def test_pp4_matches_gpipe(self):
+        tokens, labels = _batch()
+
+        def run(schedule):
+            eng = HybridEngine(CFG, pp=4, devices=jax.devices()[:4],
+                               engine_cfg=EngineConfig(
+                                   num_microbatches=8,
+                                   pipeline_schedule=schedule))
+            p, o = eng.init(seed=0)
+            out = []
+            for _ in range(3):
+                p, o, loss = eng.step(p, o, tokens, labels, lr=1e-3)
+                out.append(float(loss))
+            return out
+
+        np.testing.assert_allclose(run("1f1b"), run("gpipe"), atol=2e-4,
+                                   rtol=1e-4)
+
+    def test_pp2_mp2_sharding2_matches(self, baseline):
+        eng = HybridEngine(CFG, pp=2, mp=2, sharding=2,
+                           engine_cfg=EngineConfig(num_microbatches=4))
+        losses, _ = _run(eng)
+        np.testing.assert_allclose(losses, baseline[0], atol=2e-4,
+                                   rtol=1e-4)
+
+    def test_pp2_zero3_matches(self, baseline):
+        eng = HybridEngine(CFG, pp=2, sharding=2, dp=2,
+                           engine_cfg=EngineConfig(num_microbatches=2,
+                                                   zero_stage=3))
+        losses, _ = _run(eng)
+        np.testing.assert_allclose(losses, baseline[0], atol=2e-4,
+                                   rtol=1e-4)
+
+
+class TestMemoryBound:
+    def _temp_bytes(self, schedule, num_micro, micro_bs=2):
+        """Compiled temp bytes for a fixed PER-MICROBATCH size — the
+        memory question 1F1B answers is 'can I add microbatches to
+        amortize the bubble without growing live activations'."""
+        eng = HybridEngine(CFG, pp=2, devices=jax.devices()[:2],
+                           engine_cfg=EngineConfig(
+                               num_microbatches=num_micro,
+                               pipeline_schedule=schedule))
+        params, opt = eng.init(seed=0)
+        tokens, labels = _batch(micro_bs * num_micro)
+        import jax.numpy as jnp
+
+        fn = eng.build_step()
+        lowered = fn.lower(params, opt, jnp.asarray(tokens),
+                           jnp.asarray(labels),
+                           jnp.asarray(1e-3, jnp.float32),
+                           jnp.asarray(0, jnp.uint32))
+        mem = lowered.compile().memory_analysis()
+        # per-device temp bytes (CPU backend reports one analysis)
+        return mem.temp_size_in_bytes
+
+    def test_activation_memory_flat_in_num_micro(self):
+        """4x the microbatch count (at fixed microbatch size) must NOT
+        4x 1F1B's live activations (VERDICT r4 item 2's done-criterion:
+        activation memory flat as num_micro doubles).  GPipe's grow
+        ~linearly by construction."""
+        t4 = self._temp_bytes("1f1b", 4)
+        t16 = self._temp_bytes("1f1b", 16)
+        g4 = self._temp_bytes("gpipe", 4)
+        g16 = self._temp_bytes("gpipe", 16)
+        # gpipe grows with microbatches (sanity: the measurement sees
+        # the live activations at all)
+        assert g16 > 2.0 * g4, (g4, g16)
+        # 1f1b stays bounded (small slack for per-micro bookkeeping)
+        assert t16 < 1.5 * t4, (t4, t16)
+        assert t16 < 0.5 * g16, (t16, g16)
