@@ -174,7 +174,9 @@ class PTQ:
 
             return hook
 
-        for name, sub in model.named_sublayers():
+        # include_self: a bare-Linear model observes under the empty
+        # prefix, matching the int8 predictor's root key
+        for name, sub in model.named_sublayers(include_self=True):
             if isinstance(sub, Linear):
                 sub.register_forward_post_hook(hook_for(name))
         return model

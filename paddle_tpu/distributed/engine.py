@@ -46,7 +46,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.gpt import GPTConfig, gpt_init
 from .topology import build_mesh
 
 __all__ = ["HybridEngine", "EngineConfig"]
@@ -186,6 +185,13 @@ class EngineConfig:
     # 400M-element FFN leaf otherwise materializes 1.5 GB fp32 temps
     opt_update_window: int = 1 << 24
 
+    # fp32 logits-block budget (elements) for the tied-vocab CE head:
+    # above it the head runs in sequence chunks under lax.map +
+    # jax.checkpoint so the [b, s, V] fp32 logits/softmax never fully
+    # materialize.  Default tuned on v5e: gpt2-medium's 412M-element head
+    # is FASTER unchunked (chunking cost it 6.8% throughput) and fits;
+    # GPT-1.3B's 824M-element head (3.3 GB fp32 logits) must chunk.
+    ce_block_elems: int = 1 << 29
     # pipeline schedule (reference: pipeline_parallel.py forward_backward_
     # pipeline vs the interleaved/GPipe variants; DistributedStrategy
     # pipeline_configs["schedule_mode"]):
@@ -208,36 +214,38 @@ class EngineConfig:
 
 
 class HybridEngine:
-    def __init__(self, cfg: GPTConfig, dp=1, pp=1, sharding=1, sep=1, mp=1,
+    def __init__(self, cfg, dp=1, pp=1, sharding=1, sep=1, mp=1,
                  ep=1, engine_cfg: EngineConfig = None, mesh: Mesh = None,
                  devices=None):
+        """``cfg``: a model config (GPTConfig trains through GPTAdapter)
+        or any distributed.model_adapter.ModelAdapter instance — the
+        stage protocol that lets a second architecture train through the
+        same engine (reference: fleet.distributed_model wraps any Layer,
+        fleet_base.py:937)."""
+        from .model_adapter import GPTAdapter, ModelAdapter
+
+        if isinstance(cfg, ModelAdapter):
+            self.model = cfg
+        else:
+            self.model = GPTAdapter(cfg)
+        cfg = self.model.cfg
         self.cfg = cfg
         self.ec = engine_cfg or EngineConfig()
-        self.dp, self.pp, self.zr, self.sep, self.mp = dp, pp, sharding, sep, mp
+        self.dp, self.pp, self.zr, self.sep, self.mp = \
+            dp, pp, sharding, sep, mp
         self.ep = ep
-        assert cfg.num_layers % pp == 0, "layers must divide pp"
-        assert cfg.hidden % mp == 0 and cfg.ffn_hidden % mp == 0
-        assert cfg.num_heads % mp == 0
-        assert cfg.vocab_size % mp == 0
-        if sep > 1 and cfg.seq_parallel == "ulysses":
-            assert (cfg.num_heads // mp) % sep == 0, \
-                "Ulysses needs local heads divisible by sep " \
-                "(use seq_parallel='ring' to lift the head cap)"
+        assert cfg.seq_parallel in ("ulysses", "ring"), \
+            f"unknown seq_parallel {cfg.seq_parallel!r}"
         if pp > 1:
             assert self.ec.num_microbatches >= pp, \
                 "need microbatches >= pp for the pipeline"
-        assert cfg.seq_parallel in ("ulysses", "ring"), \
-            f"unknown seq_parallel {cfg.seq_parallel!r}"
-        if ep > 1:
-            assert cfg.moe_experts > 0, "ep>1 needs a MoE model"
-        if cfg.moe_experts:
-            assert cfg.moe_experts % ep == 0, "experts must divide ep"
         if self.ec.zero_stage >= 3 and sharding > 1:
             assert cfg.hidden % sharding == 0, \
                 "ZeRO-3 shards the hidden dim: hidden %% sharding == 0"
             if cfg.moe_experts:
                 assert cfg.ffn_hidden % sharding == 0, \
                     "ZeRO-3 MoE shards ffn_hidden over 'sharding'"
+        self.model.validate(self)
         self.mesh = mesh if mesh is not None else build_mesh(
             dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp, ep=ep,
             devices=devices)
@@ -245,37 +253,12 @@ class HybridEngine:
 
     # ------------------------------------------------------------ shardings
     def param_specs(self):
-        """Manual-mode layout: blocks pp-sharded on the layer axis, Megatron
-        column/row splits on mp, everything else replicated.  ZeRO-3
-        additionally shards each matrix leaf's free dim over 'sharding'
-        (small vectors stay replicated — stage-2 handles their opt state)."""
-        z = "sharding" if self.ec.zero_stage >= 3 and self.zr > 1 else None
-        blocks = {
-            "ln1_g": P("pp", None), "ln1_b": P("pp", None),
-            "qkv_w": P("pp", z, "mp"), "qkv_b": P("pp", "mp"),
-            "proj_w": P("pp", "mp", z), "proj_b": P("pp", None),
-            "ln2_g": P("pp", None), "ln2_b": P("pp", None),
-        }
-        if self.cfg.moe_experts:
-            # Mixtral-style EP: experts sharded over "ep"; the expert FFN
-            # inner dim stays unsharded (ep takes mp's role for the FFN)
-            blocks.update({
-                "gate_w": P("pp", None, None),
-                "up_w": P("pp", "ep", z, None), "up_b": P("pp", "ep", None),
-                "down_w": P("pp", "ep", z, None),
-                "down_b": P("pp", "ep", None),
-            })
-        else:
-            blocks.update({
-                "up_w": P("pp", z, "mp"), "up_b": P("pp", "mp"),
-                "down_w": P("pp", "mp", z), "down_b": P("pp", None),
-            })
-        return {
-            "wte": P("mp", z),                        # vocab-parallel
-            "wpe": P(None, None),
-            "blocks": blocks,
-            "lnf_g": P(None), "lnf_b": P(None),
-        }
+        """Manual-mode layout from the model adapter: blocks pp-sharded
+        on the layer axis, Megatron column/row splits on mp, everything
+        else replicated.  ZeRO-3 additionally shards each matrix leaf's
+        free dim over 'sharding' (small vectors stay replicated — stage-2
+        handles their opt state)."""
+        return self.model.param_specs(self)
 
     def _use_1f1b(self):
         """The 1F1B path serves pp>1 tied-embedding dense models; MoE and
@@ -309,12 +292,20 @@ class HybridEngine:
         return {k: self._z3_gather_leaf(v, specs[k], skip_leading=1)
                 for k, v in bp.items()}
 
-    def _wte(self, params):
-        """wte with the stage-3 shard gathered (embed + loss head)."""
-        wte = params["wte"]
-        if self._z3():
-            wte = self._z3_gather_leaf(wte, self.param_specs()["wte"])
-        return wte
+    @staticmethod
+    def _aux_params(params):
+        """The non-"blocks" params (embeddings, norms, heads) — what the
+        adapter's embed/head_loss consume."""
+        return {k: v for k, v in params.items() if k != "blocks"}
+
+    def _aux_gathered(self, aux):
+        """aux params with stage-3 shards gathered (JIT, inside remat/vjp
+        scopes so backward re-gathers instead of keeping them live)."""
+        if not self._z3():
+            return aux
+        specs = self.param_specs()
+        return {k: self._z3_gather_leaf(v, specs[k])
+                for k, v in aux.items()}
 
     # Slot storage geometry: each rank's flat chunk is padded to a multiple
     # of _SLOT_LANE and stored as [..., rows, _SLOT_LANE].  The trailing
@@ -360,13 +351,12 @@ class HybridEngine:
 
     # ---------------------------------------------------------------- init
     def init(self, seed=0):
-        """Build sharded params + optimizer state (fp32 master + moments,
-        each ZeRO-sharded over 'sharding')."""
-        cfg = self.cfg
+        """Build sharded params + optimizer state (master + moments per
+        opt_dtype/master_weights, each ZeRO-sharded over 'sharding')."""
         specs = self.param_specs()
 
         def make_params(key):
-            return gpt_init(cfg, key)
+            return self.model.init(key)
 
         shardings = jax.tree_util.tree_map(
             lambda spec: NamedSharding(self.mesh, spec), specs,
@@ -563,11 +553,8 @@ class HybridEngine:
         checkpoint.load_engine_state on this topology."""
         import types
 
-        from ..models.gpt import gpt_init
-
         specs = self.param_specs()
-        shapes = jax.eval_shape(lambda k: gpt_init(self.cfg, k),
-                                jax.random.key(0))
+        shapes = jax.eval_shape(self.model.init, jax.random.key(0))
 
         def tmpl(sds, spec, dtype=None):
             return types.SimpleNamespace(
@@ -588,7 +575,8 @@ class HybridEngine:
 
     # ------------------------------------------------------- forward pieces
     def _embed(self, params, tokens):
-        return self._embed_core(self._wte(params), params["wpe"], tokens)
+        return self.model.embed(
+            self, self._aux_gathered(self._aux_params(params)), tokens)
 
     def _embed_core(self, wte, wpe, tokens):
         """Vocab-parallel embedding + position embedding.
@@ -611,84 +599,35 @@ class HybridEngine:
             wpe, sep_idx * s_local, s_local, axis=0)
         return (emb + pos).astype(self.cfg.jdtype())
 
-    def _attention(self, q, k, v):
+    def _attention(self, q, k, v, causal=True):
         """Flash attention with sequence parallelism (Ulysses or ring).
         q/k/v: [B, H_local, s_local, hd]."""
         sep = self.sep
         if sep > 1 and self.cfg.seq_parallel == "ring":
             from ..kernels.ring_attention import ring_attention
 
-            return ring_attention(q, k, v, "sep", causal=True)
+            return ring_attention(q, k, v, "sep", causal=causal)
         if sep > 1:
             # all_to_all: gather sequence, scatter heads → [B, H/sep, S, hd]
             q, k, v = (jax.lax.all_to_all(t, "sep", split_axis=1,
                                           concat_axis=2, tiled=True)
                        for t in (q, k, v))
-        out = self._flash(q, k, v)
+        out = self._flash(q, k, v, causal)
         if sep > 1:
             out = jax.lax.all_to_all(out, "sep", split_axis=2, concat_axis=1,
                                      tiled=True)
         return out
 
-    def _flash(self, q, k, v):
+    def _flash(self, q, k, v, causal=True):
         from ..kernels.flash_attention import (flash_attention,
                                                flash_attention_available)
 
         if self.cfg.use_flash and flash_attention_available(q, k, v, None,
-                                                            causal=True):
-            return flash_attention(q, k, v, causal=True)
+                                                            causal=causal):
+            return flash_attention(q, k, v, causal=causal)
         from ..ops.attention import _naive_attention
 
-        return _naive_attention(q, k, v, causal=True, training=False)
-
-    def _block(self, bp, x, key=None):
-        """One TP transformer block on local shards.
-        x: [B, s_local, D] (replicated over mp).  ``key`` must be
-        mp-INVARIANT (identical masks across a TP group — the reference's
-        RNGStatesTracker 'global_seed' discipline) and data-axis-varying
-        (distinct masks per data shard)."""
-        cfg, mp = self.cfg, self.mp
-        B, s_local, D = x.shape
-        H_local = cfg.num_heads // mp
-        hd = cfg.head_dim
-        from ..models.gpt import _dropout, _layer_norm
-
-        k_attn = k_ffn = None
-        if key is not None and cfg.dropout > 0.0:
-            k_attn, k_ffn = jax.random.split(key)
-
-        h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
-        qkv = jnp.einsum("bsd,de->bse", h, bp["qkv_w"]) + bp["qkv_b"]
-        # global qkv column order is head-major [H, 3, hd] so an mp shard is
-        # a whole group of heads (models/gpt.py uses the same layout)
-        qkv = qkv.reshape(B, s_local, H_local, 3, hd)
-        q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)
-        k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
-        v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
-        attn = self._attention(q, k, v)          # [B, H_local, s_local, hd]
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, s_local, H_local * hd)
-        proj = jnp.einsum("bse,ed->bsd", attn, bp["proj_w"])
-        proj = _psum_varying(proj, ("mp",))
-        x = x + _dropout(proj + bp["proj_b"], cfg.dropout, k_attn)
-
-        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
-        if cfg.moe_experts:
-            from .moe import moe_layer
-
-            y, aux = moe_layer(
-                {"gate_w": bp["gate_w"], "up_w": bp["up_w"],
-                 "up_b": bp["up_b"], "down_w": bp["down_w"],
-                 "down_b": bp["down_b"]},
-                h, top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                ep_axis="ep" if self.ep > 1 else None)
-            return x + _dropout(y, cfg.dropout, k_ffn), aux
-        h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
-        h = jax.nn.gelu(h, approximate=True)
-        down = jnp.einsum("bsf,fd->bsd", h, bp["down_w"])
-        down = _psum_varying(down, ("mp",))
-        return x + _dropout(down + bp["down_b"], cfg.dropout, k_ffn), \
-            jnp.zeros((), jnp.float32)
+        return _naive_attention(q, k, v, causal=causal, training=False)
 
     def _stage(self, blocks_local, x, key=None):
         """Scan this pipeline stage's blocks with per-block remat.
@@ -698,8 +637,8 @@ class HybridEngine:
         backward (explicit key = the reference's RNG-state preservation)."""
         from .recompute import checkpoint_policy
 
-        block_fn = lambda bp, x, k: self._block(self._z3_gather_block(bp),
-                                                x, k)
+        block_fn = lambda bp, x, k: self.model.block(
+            self, self._z3_gather_block(bp), x, k)
         if self.cfg.remat != "nothing":
             block_fn = jax.checkpoint(
                 block_fn, policy=checkpoint_policy(self.cfg.remat),
@@ -725,31 +664,17 @@ class HybridEngine:
             body, (x, aux0), (blocks_local, jnp.arange(n_local)))
         return out, aux_sum
 
-    def _head_params(self, params):
-        """The loss head's own params (wte stage-3 pre-gathered)."""
-        return {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
-                "wte": self._wte(params)}
-
-    # fp32 logits-block budget for the loss head (elements).  Above it the
-    # head runs in sequence chunks under lax.map + jax.checkpoint so the
-    # [b, s, V] fp32 logits/softmax never fully materialize — at GPT-1.3B
-    # (V=50304, s=2048) the un-chunked head holds >1.6 GB of fp32 per
-    # microbatch plus softmax residuals for backward.
-    _CE_BLOCK_ELEMS = 1 << 26
-
-    def _loss_head(self, hp, x, labels):
-        """Final LN + tied-embedding logits + vocab-parallel CE.
-        hp: head params (see _head_params); x: [b, s_local, D];
-        labels: [b, s_local]. Returns (sum_loss, count)."""
-        cfg, mp = self.cfg, self.mp
-        from ..models.gpt import _layer_norm
+    def tied_vocab_ce(self, x, wte, labels):
+        """Chunked vocab-parallel CE against the (tied) embedding —
+        the shared loss-head building block for model adapters.
+        x: [b, s_local, D]; wte local: [V/mp, D]; labels: [b, s_local]
+        with -100 = ignore.  Returns (sum_loss, count)."""
+        mp = self.mp
         from .mp_layers import parallel_cross_entropy
-
-        x = _layer_norm(x, hp["lnf_g"], hp["lnf_b"])
 
         def ce_chunk(xc, lc):
             logits = jnp.einsum("bsd,vd->bsv", xc,
-                                hp["wte"]).astype(jnp.float32)
+                                wte).astype(jnp.float32)
             if mp > 1:
                 loss_tok = parallel_cross_entropy(logits, lc, mp_axis="mp")
             else:
@@ -764,9 +689,9 @@ class HybridEngine:
                 mask.sum()
 
         b, s, _ = x.shape
-        v_local = hp["wte"].shape[0]
+        v_local = wte.shape[0]
         nchunk = 1
-        while (b * s * v_local) // nchunk > self._CE_BLOCK_ELEMS \
+        while (b * s * v_local) // nchunk > self.ec.ce_block_elems \
                 and s % (2 * nchunk) == 0:
             nchunk *= 2
         if nchunk == 1:
@@ -797,22 +722,16 @@ class HybridEngine:
         return total / denom
 
     # --------------------------------------------------- 1F1B (hand vjp)
-    def _loss_head_raw(self, hp_raw, y, labels):
-        """_loss_head over UN-gathered head params (z3 wte gather inside,
-        so vjp emits shard-formed wte cotangents directly)."""
-        wte = hp_raw["wte"]
-        if self._z3():
-            wte = self._z3_gather_leaf(wte, self.param_specs()["wte"])
-        return self._loss_head({"lnf_g": hp_raw["lnf_g"],
-                                "lnf_b": hp_raw["lnf_b"], "wte": wte}, y,
-                               labels)
+    def _head_raw(self, aux_raw, y, labels):
+        """Adapter head over UN-gathered aux params (z3 gather inside, so
+        vjp emits shard-formed cotangents directly)."""
+        return self.model.head_loss(self, self._aux_gathered(aux_raw), y,
+                                    labels)
 
-    def _embed_raw(self, wte_raw, wpe, tokens, key):
-        """Embedding over the UN-gathered wte + per-micro embed dropout."""
-        if self._z3():
-            wte_raw = self._z3_gather_leaf(wte_raw,
-                                           self.param_specs()["wte"])
-        x = self._embed_core(wte_raw, wpe, tokens)
+    def _embed_raw(self, aux_raw, tokens, key):
+        """Adapter embedding over UN-gathered aux params + per-micro
+        embed dropout (inside the vjp'd fn so backward recomputes it)."""
+        x = self.model.embed(self, self._aux_gathered(aux_raw), tokens)
         if key is not None:
             from ..models.gpt import _dropout
 
@@ -880,9 +799,11 @@ class HybridEngine:
         seed = lift(1.0 / denom)
 
         blocks_l = ltree(params["blocks"])
-        hp_raw_l = ltree({"lnf_g": params["lnf_g"],
-                          "lnf_b": params["lnf_b"], "wte": params["wte"]})
-        wpe_l = lift(params["wpe"])
+        # ONE lifted dict of all non-block params: the embed and the head
+        # each vjp against the whole dict (unused leaves get zero
+        # cotangents), so tied leaves — e.g. GPT's wte in both embed and
+        # head — accumulate into a single gradient with no special-casing
+        aux_l = ltree(self._aux_params(params))
         tok_mb_l = lift(tokens.reshape(M, mb, s_local))
         lab_mb_l = lift(labels.reshape(M, mb, s_local))
 
@@ -894,14 +815,11 @@ class HybridEngine:
             return lift(jnp.zeros((mb, s_local, D), x_dtype))
 
         zeros_g_bl = jax.tree_util.tree_map(zlike, params["blocks"])
-        zeros_dhp = {"lnf_g": zlike(params["lnf_g"]),
-                     "lnf_b": zlike(params["lnf_b"]),
-                     "wte": zlike(params["wte"])}
-        zeros_wpe = zlike(params["wpe"])
+        zeros_g_aux = jax.tree_util.tree_map(zlike, self._aux_params(params))
         zero = lambda: lift(jnp.zeros((), jnp.float32))
 
         def tick(carry, t):
-            ring, x_next, ct_next, g_bl, g_hp, g_wpe, loss_sum = carry
+            ring, x_next, ct_next, g_bl, g_aux, loss_sum = carry
             frow = jax.lax.dynamic_index_in_dim(fwd_sched, t, 0,
                                                 keepdims=False)
             brow = jax.lax.dynamic_index_in_dim(bwd_sched, t, 0,
@@ -921,8 +839,8 @@ class HybridEngine:
             def run_fwd(ring, x_next):
                 x0 = jax.lax.cond(
                     pp_idx == 0,
-                    lambda: lift(self._embed_raw(
-                        hp_raw_l["wte"], wpe_l, tok_mb_l[mf], kef)),
+                    lambda: lift(self._embed_raw(aux_l, tok_mb_l[mf],
+                                                 kef)),
                     lambda: x_next)
                 y = lift(stage_fn(blocks_l, x0, kf))
                 ring = jax.lax.dynamic_update_index_in_dim(
@@ -938,25 +856,24 @@ class HybridEngine:
             x_saved = jax.lax.dynamic_index_in_dim(ring, mbi % pp, 0,
                                                    keepdims=False)
 
-            def run_bwd(y, ct_next, g_bl, g_hp, g_wpe, loss_sum):
+            def run_bwd(y, ct_next, g_bl, g_aux, loss_sum):
                 # last stage: build the cotangent from the head's vjp at
                 # this tick's own forward output (the schedule guarantees
                 # my_b == my_f there); other stages take the arrived one
                 def head_ct(y):
                     (s_m, c_m), pull = jax.vjp(
-                        lambda hp_, y_: self._loss_head_raw(hp_, y_,
-                                                            lab_b),
-                        hp_raw_l, y)
-                    dhp, dy = pull((seed, jnp.zeros_like(c_m)))
-                    return lift(dy), ltree(dhp), lift(s_m)
+                        lambda a_, y_: self._head_raw(a_, y_, lab_b),
+                        aux_l, y)
+                    da, dy = pull((seed, jnp.zeros_like(c_m)))
+                    return lift(dy), ltree(da), lift(s_m)
 
                 def recv_ct(y):
-                    return ct_next, zeros_dhp, zero()
+                    return ct_next, zeros_g_aux, zero()
 
-                dy, dhp, s_m = jax.lax.cond(pp_idx == pp - 1, head_ct,
-                                            recv_ct, y)
+                dy, da, s_m = jax.lax.cond(pp_idx == pp - 1, head_ct,
+                                           recv_ct, y)
                 loss_sum = loss_sum + s_m
-                g_hp = jax.tree_util.tree_map(jnp.add, g_hp, dhp)
+                g_aux = jax.tree_util.tree_map(jnp.add, g_aux, da)
                 # stage vjp at the saved input (stage-granular recompute)
                 _, pull = jax.vjp(
                     lambda bl, x: stage_fn(bl, x, kb), blocks_l, x_saved)
@@ -968,24 +885,20 @@ class HybridEngine:
                 # embedding's params instead of sending it further back
                 def emb_bwd(dx):
                     _, epull = jax.vjp(
-                        lambda w, p: self._embed_raw(w, p, tok_mb_l[mbi],
-                                                     keb),
-                        hp_raw_l["wte"], wpe_l)
-                    dwte, dwpe = epull(dx)
-                    return lift(dwte), lift(dwpe)
+                        lambda a_: self._embed_raw(a_, tok_mb_l[mbi],
+                                                   keb), aux_l)
+                    (de,) = epull(dx)
+                    return ltree(de)
 
-                dwte, dwpe = jax.lax.cond(
-                    pp_idx == 0, emb_bwd,
-                    lambda dx: (zeros_dhp["wte"], zeros_wpe), dx)
-                g_hp = {"lnf_g": g_hp["lnf_g"], "lnf_b": g_hp["lnf_b"],
-                        "wte": g_hp["wte"] + dwte}
-                g_wpe = g_wpe + dwpe
-                return dx, g_bl, g_hp, g_wpe, loss_sum
+                de = jax.lax.cond(pp_idx == 0, emb_bwd,
+                                  lambda dx: zeros_g_aux, dx)
+                g_aux = jax.tree_util.tree_map(jnp.add, g_aux, de)
+                return dx, g_bl, g_aux, loss_sum
 
-            dx_send, g_bl, g_hp, g_wpe, loss_sum = jax.lax.cond(
+            dx_send, g_bl, g_aux, loss_sum = jax.lax.cond(
                 my_b >= 0, run_bwd,
-                lambda y, c, a, b_, c_, d_: (zero_act(), a, b_, c_, d_),
-                y, ct_next, g_bl, g_hp, g_wpe, loss_sum)
+                lambda y, c, a, b_, c_: (zero_act(), a, b_, c_),
+                y, ct_next, g_bl, g_aux, loss_sum)
 
             # sticky mailboxes: latch the arrived value ONLY when the
             # schedule says the sender was active this tick — an idle
@@ -999,17 +912,16 @@ class HybridEngine:
             ct_from = jnp.take(brow, (pp_idx + 1) % pp) >= 0
             x_next = jnp.where(x_from, x_arr, x_next)
             ct_next = jnp.where(ct_from, ct_arr, ct_next)
-            return (ring, x_next, ct_next, g_bl, g_hp, g_wpe,
-                    loss_sum), None
+            return (ring, x_next, ct_next, g_bl, g_aux, loss_sum), None
 
         ring0 = lift(jnp.zeros((pp, mb, s_local, D), x_dtype))
-        carry0 = (ring0, zero_act(), zero_act(), zeros_g_bl, zeros_dhp,
-                  zeros_wpe, zero())
-        (ring, _, _, g_bl, g_hp, g_wpe, loss_sum), _ = jax.lax.scan(
+        carry0 = (ring0, zero_act(), zero_act(), zeros_g_bl, zeros_g_aux,
+                  zero())
+        (ring, _, _, g_bl, g_aux, loss_sum), _ = jax.lax.scan(
             tick, carry0, jnp.arange(T))
 
-        grads = {"wte": g_hp["wte"], "wpe": g_wpe, "blocks": g_bl,
-                 "lnf_g": g_hp["lnf_g"], "lnf_b": g_hp["lnf_b"]}
+        grads = dict(g_aux)
+        grads["blocks"] = g_bl
 
         def sync(g, p):
             extra = tuple(a for a in jax.typeof(g).vma
@@ -1038,7 +950,9 @@ class HybridEngine:
 
         if pp == 1:
             out, aux = self._stage(params["blocks"], x, key)
-            s, c = self._loss_head(self._head_params(params), out, labels)
+            s, c = self.model.head_loss(
+                self, self._aux_gathered(self._aux_params(params)), out,
+                labels)
             total = _psum_varying(jnp.stack([s, c]))
             loss = total[0] / jnp.maximum(total[1], 1.0)
             if cfg.moe_experts:
@@ -1070,7 +984,8 @@ class HybridEngine:
         # de-varying psum over 'pp' inside the branch, where only the live
         # stages execute it → collective mismatch at runtime.  Lifting
         # outside puts the transpose psum on the all-ranks path.
-        hp = jax.tree_util.tree_map(lift, self._head_params(params))
+        hp = jax.tree_util.tree_map(
+            lift, self._aux_gathered(self._aux_params(params)))
         lab_mb_l = lift(lab_mb)
 
         def tick(carry, t):
@@ -1104,7 +1019,7 @@ class HybridEngine:
             lab = lab_mb_l[jnp.clip(m, 0, num_micro - 1)]
 
             def live_head(yy, ll):
-                s_, c_ = self._loss_head(hp, yy, ll)
+                s_, c_ = self.model.head_loss(self, hp, yy, ll)
                 return lift(s_), lift(c_)
 
             s, c = jax.lax.cond(
@@ -1256,9 +1171,7 @@ class HybridEngine:
         for path, p, slots, g, z3 in zip(paths, flat_p, flat_slots, g_chunks,
                                          z3_leaf):
             decay = ec.weight_decay
-            decay_on = bool(decay) and \
-                ("ln" not in path.split("/")[-1]) and \
-                not path.endswith("_b")
+            decay_on = bool(decay) and self.model.decay_this(path)
             w_store = (slots["master"] if has_master
                        else self._param_chunk(p, z3))
 
@@ -1294,8 +1207,16 @@ class HybridEngine:
                 # O(window) and — unlike a pad+reshape+lax.map — no
                 # stacked copy of g/m/v/w ever materializes (measured:
                 # 6 x 768 MB of copies for a 302M-element leaf)
-                w_out0 = (w_f if w_f.dtype == p.dtype
-                          else jnp.zeros((C,), p.dtype))
+                if w_f.dtype == p.dtype:
+                    w_out0 = w_f
+                else:
+                    # fresh output buffer must already carry the vma the
+                    # windows written into it will have (fori_loop needs
+                    # a fixed carry type)
+                    from ..core.vma import lift_to, vma_of
+
+                    w_out0 = lift_to(jnp.zeros((C,), p.dtype),
+                                     vma_of(w_f, g_f))
                 bufs0 = (m_f, v_f, w_out0) + ((w_f,) if has_master else ())
 
                 def win_body(i, bufs):
@@ -1381,10 +1302,8 @@ class HybridEngine:
     # ----------------------------------------------------------- eval/debug
     def loss_fn_reference(self, params_host, tokens, labels):
         """Single-device reference loss for parity tests (same math, no
-        parallelism): uses the functional GPT directly."""
-        from ..models.gpt import gpt_loss
-
-        return gpt_loss(self.cfg, params_host, tokens, labels)
+        parallelism): delegates to the model adapter's functional form."""
+        return self.model.reference_loss(params_host, tokens, labels)
 
     def gather_params(self, params):
         """Fetch full (host) params pytree from sharded arrays."""

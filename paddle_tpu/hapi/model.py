@@ -49,12 +49,25 @@ class Model:
         self._jit_step = None
         self._jit_eval = None
         self._opt_state = None   # functional optimizer state (jit path)
+        self._mesh = None        # dp mesh (prepare(device_mesh=...))
 
     # ------------------------------------------------------------- prepare
-    def prepare(self, optimizer=None, loss=None, metrics=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                device_mesh=None):
+        """``device_mesh``: None = single device; "auto" = data-parallel
+        over every local device; or a jax.sharding.Mesh with a "dp" axis.
+        The reference wires DP implicitly via prepare_distributed_context
+        (hapi/model.py:191) when launched under fleet — on TPU the mesh
+        IS that context: the batch is sharded over "dp", params stay
+        replicated, and XLA inserts the gradient all-reduce."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
+        if device_mesh == "auto":
+            from jax.sharding import Mesh
+
+            device_mesh = Mesh(np.array(jax.devices()), ("dp",))
+        self._mesh = device_mesh
         return self
 
     # ---------------------------------------------------------- jit pieces
@@ -87,11 +100,27 @@ class Model:
         self._jit_step = jax.jit(step)
         return self._jit_step
 
+    def _shard_batch(self, x, y):
+        """Place the batch dp-sharded on the mesh (replicated elsewhere);
+        no-op without a mesh."""
+        if self._mesh is None:
+            return x, y
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dp = self._mesh.shape["dp"]
+        if x.shape[0] % dp:
+            raise ValueError(
+                f"distributed fit: batch size {x.shape[0]} must divide "
+                f"the dp mesh degree {dp}")
+        sh = NamedSharding(self._mesh, P("dp"))
+        return jax.device_put(x, sh), jax.device_put(y, sh)
+
     # ------------------------------------------------- train / eval batch
     def train_batch(self, inputs, labels):
         """One optimization step; returns (loss, metric results)."""
         x = _as_array(_to_list(inputs)[0])
         y = _as_array(_to_list(labels)[0])
+        x, y = self._shard_batch(x, y)
         opt = self._optimizer
         if hasattr(opt, "apply_gradients"):
             params, buffers = self.network.raw_state()
@@ -123,6 +152,7 @@ class Model:
     def eval_batch(self, inputs, labels):
         x = _as_array(_to_list(inputs)[0])
         y = _as_array(_to_list(labels)[0])
+        x, y = self._shard_batch(x, y)
         params, buffers = self.network.raw_state()
 
         if self._jit_eval is None:
@@ -168,7 +198,10 @@ class Model:
     def _loader(self, data, batch_size, shuffle):
         if data is None or isinstance(data, DataLoader):
             return data
-        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        # under a dp mesh the ragged tail batch cannot shard: drop it
+        # (the reference's distributed sampler pads/drops the same way)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=self._mesh is not None)
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,

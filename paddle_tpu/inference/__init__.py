@@ -195,7 +195,9 @@ class Predictor:
 
         scales = self.config.quant_scales or {}
         quantized = {}
-        for name, sub in layer.named_sublayers():
+        # include_self: the model may itself BE a Linear (ADVICE r4) —
+        # the root is keyed by its empty-prefix name, matching PTQ scales
+        for name, sub in layer.named_sublayers(include_self=True):
             if isinstance(sub, Linear):
                 entry = scales.get(name)
                 act = (entry or {}).get("activation")

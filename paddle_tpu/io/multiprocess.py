@@ -256,6 +256,17 @@ class MultiprocessIter:
         # draining so its put completes, it sees the stop event and
         # exits, and the segment gets unlinked below
         self._stop.set()
+        if self.index_q is not None:
+            # map-mode workers blocked in index_q.get() never reach the
+            # stop-event check at the loop top: push one None sentinel
+            # per worker so they wake and exit promptly instead of
+            # waiting out the full deadline and being terminated
+            # (ADVICE r4: early break stalled 10s before terminate())
+            for _ in range(self.nw):
+                try:
+                    self.index_q.put_nowait(None)
+                except Exception:
+                    break
         deadline = _time.monotonic() + 10.0
         while (any(p.is_alive() for p in self._procs)
                and _time.monotonic() < deadline):

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
 
 
 def _np(x):
@@ -133,3 +133,56 @@ class Recall(_BinaryStat):
     def accumulate(self):
         d = self.tp + self.fn
         return self.tp / d if d else 0.0
+
+
+class Auc(Metric):
+    """Bucketed streaming AUC for binary classification (reference:
+    python/paddle/metric/metrics.py:592 ``Auc``).
+
+    Predictions are histogrammed into ``num_thresholds + 1`` score
+    buckets per class, so ``accumulate`` is exact for the discretized
+    curve and ``update`` is O(batch) regardless of history.  ROC mode
+    integrates TPR over FPR (trapezoid); this vectorized form computes
+    the same area via descending-threshold cumulative sums.
+
+    ``preds``: [N, 2] class probabilities (column 1 = positive) or [N]
+    positive-class scores in [0, 1]; ``labels``: [N] or [N, 1] in {0, 1}.
+    """
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__(name)
+        if curve != "ROC":
+            raise ValueError(
+                f"Auc: only the 'ROC' curve is implemented, got {curve!r}"
+                " (matches the reference: 'only implement the ROC curve"
+                " type via Python now')")
+        self._nt = int(num_thresholds)
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._nt + 1, np.float64)
+        self._stat_neg = np.zeros(self._nt + 1, np.float64)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        labels = _np(labels).reshape(-1).astype(bool)
+        score = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        bins = np.clip((score * self._nt).astype(np.int64), 0, self._nt)
+        self._stat_pos += np.bincount(bins[labels],
+                                      minlength=self._nt + 1)
+        self._stat_neg += np.bincount(bins[~labels],
+                                      minlength=self._nt + 1)
+        return self.accumulate()
+
+    def accumulate(self):
+        # sweep thresholds from high to low: cumulative TP/FP counts per
+        # bucket edge, then trapezoid in (FP, TP) space
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0.0 or tot_neg == 0.0:
+            return 0.0
+        tp_prev = np.concatenate([[0.0], tp[:-1]])
+        fp_prev = np.concatenate([[0.0], fp[:-1]])
+        area = np.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+        return float(area / (tot_pos * tot_neg))

@@ -1,0 +1,179 @@
+"""BERT — bidirectional encoder with a masked-LM head.
+
+Parity role: the reference's BERT pretrain family (its fleet hybrid
+configs train BERT the same way they train GPT; see also
+python/paddle/text).  Architecture per Devlin et al. with the pre-LN
+block shared with GPT (models/gpt.py gpt_block) and the canonical MLM
+head: dense + gelu + LayerNorm transform, then logits through the TIED
+token embedding.
+
+Functional-first like gpt.py: params in a pytree, blocks stacked
+[L, ...] for lax.scan / pipeline-stage use.  The HybridEngine trains it
+through distributed.model_adapter.BertAdapter — no engine changes.
+
+MLM contract: ``tokens`` are the corrupted input ids, ``labels`` the
+original ids at masked positions and -100 elsewhere (the engine's
+(tokens, labels) step signature).  Token-type/segment embeddings exist
+in the params ("wtt"); the pretrain path feeds segment 0 (NSP-free,
+RoBERTa-style) — pass explicit ``token_type_ids`` to ``bert_forward``
+for the two-segment tasks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BertConfig", "bert_init", "bert_embed", "bert_mlm_transform",
+           "bert_forward", "bert_loss", "BERT_CONFIGS"]
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class BertConfig:
+    vocab_size: int = 30592          # multiple of 128 for MXU/TP tiling
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: int = 3072
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+    use_flash: bool = True
+    remat: str = "dots"
+    seq_parallel: str = "ulysses"
+    # engine-protocol constants (the adapter contract): BERT has no MoE
+    # and always ties the MLM vocab projection to wte
+    moe_experts: int = 0
+    tie_embeddings: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+    def jdtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+BERT_CONFIGS = {
+    "bert-base": BertConfig(hidden=768, num_layers=12, num_heads=12,
+                            ffn_hidden=3072),
+    "bert-large": BertConfig(hidden=1024, num_layers=24, num_heads=16,
+                             ffn_hidden=4096),
+    "tiny": BertConfig(vocab_size=1024, max_seq_len=128, hidden=128,
+                       num_layers=4, num_heads=4, ffn_hidden=512),
+}
+
+
+def bert_init(cfg: BertConfig, key=None, dtype=None):
+    key = key if key is not None else jax.random.key(0)
+    dt = dtype or cfg.jdtype()
+    D, F, L, V = cfg.hidden, cfg.ffn_hidden, cfg.num_layers, cfg.vocab_size
+    k = iter(jax.random.split(key, 16))
+
+    def init(key_, shape, std=0.02):
+        return (jax.random.normal(key_, shape, jnp.float32) * std).astype(dt)
+
+    resid_std = 0.02 / math.sqrt(2 * L)
+    return {
+        "wte": init(next(k), (V, D)),
+        "wpe": init(next(k), (cfg.max_seq_len, D), 0.01),
+        "wtt": init(next(k), (cfg.type_vocab_size, D), 0.01),
+        "emb_ln_g": jnp.ones((D,), dt), "emb_ln_b": jnp.zeros((D,), dt),
+        "blocks": {
+            "ln1_g": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+            "qkv_w": init(next(k), (L, D, 3 * D)),
+            "qkv_b": jnp.zeros((L, 3 * D), dt),
+            "proj_w": init(next(k), (L, D, D), resid_std),
+            "proj_b": jnp.zeros((L, D), dt),
+            "ln2_g": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+            "up_w": init(next(k), (L, D, F)),
+            "up_b": jnp.zeros((L, F), dt),
+            "down_w": init(next(k), (L, F, D), resid_std),
+            "down_b": jnp.zeros((L, D), dt),
+        },
+        "mlm_w": init(next(k), (D, D)),
+        "mlm_b": jnp.zeros((D,), dt),
+        "mlm_ln_g": jnp.ones((D,), dt), "mlm_ln_b": jnp.zeros((D,), dt),
+    }
+
+
+def bert_embed(cfg: BertConfig, aux, tokens, token_type_ids=None,
+               engine=None):
+    """Token + position + token-type embedding, then embedding LN.
+
+    With ``engine`` set (SPMD path) the token lookup is vocab-parallel
+    over mp and positions offset by the sep shard (the engine's
+    _embed_core); standalone it is a plain take."""
+    from .gpt import _layer_norm
+
+    if engine is not None:
+        x = engine._embed_core(aux["wte"], aux["wpe"], tokens)
+    else:
+        S = tokens.shape[1]
+        x = (jnp.take(aux["wte"], tokens, axis=0)
+             + aux["wpe"][:S]).astype(cfg.jdtype())
+    tt = (jnp.zeros_like(tokens) if token_type_ids is None
+          else token_type_ids)
+    x = x + jnp.take(aux["wtt"], tt, axis=0).astype(x.dtype)
+    return _layer_norm(x, aux["emb_ln_g"], aux["emb_ln_b"])
+
+
+def bert_mlm_transform(cfg: BertConfig, aux, x):
+    """The canonical MLM head transform: dense + gelu + LN (before the
+    tied vocab projection)."""
+    from .gpt import _layer_norm
+
+    h = jnp.einsum("bsd,de->bse", x, aux["mlm_w"]) + aux["mlm_b"]
+    h = jax.nn.gelu(h, approximate=True)
+    return _layer_norm(h, aux["mlm_ln_g"], aux["mlm_ln_b"])
+
+
+def bert_forward(cfg: BertConfig, params, tokens, token_type_ids=None):
+    """tokens [B, S] -> final hidden states [B, S, D] (single device,
+    bidirectional attention)."""
+    x = bert_embed(cfg, params, tokens, token_type_ids)
+    x, _ = jax.lax.scan(_bert_block_body(cfg), x, params["blocks"])
+    return x
+
+
+def _bert_block_body(cfg):
+    from .gpt import _layer_norm
+
+    def body(x, bp):
+        B, S, D = x.shape
+        H, hd = cfg.num_heads, cfg.head_dim
+        h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+        qkv = (jnp.einsum("bsd,de->bse", h, bp["qkv_w"]) + bp["qkv_b"])
+        qkv = qkv.reshape(B, S, H, 3, hd)
+        q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)
+        k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
+        from ..ops.attention import _naive_attention
+
+        attn = _naive_attention(q, k, v, causal=False, training=False)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+        x = x + jnp.einsum("bse,ed->bsd", attn, bp["proj_w"]) + bp["proj_b"]
+        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+        h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + jnp.einsum("bsf,fd->bsd", h, bp["down_w"]) + bp["down_b"]
+        return x, None
+
+    return body
+
+
+def bert_loss(cfg: BertConfig, params, tokens, labels,
+              token_type_ids=None):
+    """Masked-LM cross entropy in fp32 over the -100-masked labels —
+    the single-device parity oracle for BertAdapter."""
+    x = bert_forward(cfg, params, tokens, token_type_ids)
+    x = bert_mlm_transform(cfg, params, x)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    mask = (labels != -100).astype(jnp.float32)
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)

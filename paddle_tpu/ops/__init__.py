@@ -18,5 +18,6 @@ from .random_ops import *  # noqa: F401,F403
 from .nn_ops import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 
 from ..core.dispatch import OP_REGISTRY, get_op, list_ops  # noqa: F401

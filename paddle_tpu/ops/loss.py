@@ -173,3 +173,89 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
     if prior_dist is not None:
         return (1 - epsilon) * label + epsilon * prior_dist
     return (1 - epsilon) * label + epsilon / k
+
+
+@register_op("ctc_loss")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss (reference:
+    paddle/fluid/operators/warpctc_op.cc wrapping warp-ctc;
+    paddle.nn.functional.ctc_loss semantics).
+
+    log_probs: [T, B, C] log-softmax'd frame predictions; labels: [B, S]
+    int targets (padded arbitrarily past label_lengths); input_lengths /
+    label_lengths: [B].  Returns per-sample negative log likelihood
+    ([B]; reduced per ``reduction``, mean = sum/label_len then mean like
+    the reference).
+
+    TPU-first: the alpha recursion runs as ONE lax.scan over time on the
+    extended-label lattice [B, 2S+1] in log space — no Python loop, no
+    data-dependent shapes (length masking freezes alpha past
+    input_length).
+    """
+    lp = log_probs if not hasattr(log_probs, "data") else log_probs.data
+    lp = jnp.asarray(lp, jnp.float32)
+    lab = jnp.asarray(labels if not hasattr(labels, "data")
+                      else labels.data, jnp.int32)
+    T, B, C = lp.shape
+    S = lab.shape[1]
+    in_len = jnp.asarray(input_lengths if not hasattr(input_lengths, "data")
+                         else input_lengths.data, jnp.int32)
+    lab_len = jnp.asarray(label_lengths if not hasattr(label_lengths, "data")
+                          else label_lengths.data, jnp.int32)
+
+    NEG = -1e30
+    # extended labels: blank, l1, blank, l2, ..., blank  -> [B, 2S+1]
+    L = 2 * S + 1
+    pos = jnp.arange(L)
+    ext = jnp.where(pos % 2 == 0, blank,
+                    lab[:, jnp.minimum(pos // 2, S - 1)])
+    ext_len = 2 * lab_len + 1
+
+    # skip transition (i-2 -> i) allowed where ext[i] != blank and
+    # ext[i] != ext[i-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), blank, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (pos[None, :] % 2 == 1) & (ext != ext_m2) & (pos[None, :] >= 2)
+
+    def emit(t):
+        return jnp.take_along_axis(lp[t], ext, axis=1)     # [B, L]
+
+    alpha0 = jnp.full((B, L), NEG)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    first_lab = jnp.take_along_axis(lp[0], lab[:, :1], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_lab, NEG))
+
+    def lse(*xs):
+        st = jnp.stack(xs, 0)
+        m = jnp.max(st, 0)
+        safe = jnp.where(m <= NEG / 2, NEG, m)
+        return jnp.where(
+            m <= NEG / 2, NEG,
+            safe + jnp.log(jnp.sum(jnp.exp(st - safe), 0)))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        a = lse(alpha, prev1, prev2) + emit(t)
+        # past this sample's input length the lattice freezes
+        live = (t < in_len)[:, None]
+        a = jnp.where(live, a, alpha)
+        return a, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # NLL: logsumexp of the last two lattice positions at t = in_len - 1
+    last = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], 1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(ext_len - 2, 0)[:, None], 1)[:, 0]
+    nll = -lse(last, jnp.where(lab_len > 0, last2, NEG))
+    if norm_by_times:
+        nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    if reduction == "none":
+        return nll
+    if reduction == "sum":
+        return nll.sum()
+    # 'mean': divide each sample by its label length, then batch-mean
+    # (paddle/torch zero_infinity=False semantics)
+    return (nll / jnp.maximum(lab_len.astype(jnp.float32), 1.0)).mean()

@@ -82,3 +82,77 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False):
     side = "right" if right else "left"
     out = jnp.searchsorted(sorted_sequence, x, side=side)
     return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def beam_search_step(pre_scores, log_probs, beam_size, end_id,
+                     finished=None):
+    """One beam expansion (reference role:
+    paddle/fluid/operators/beam_search_op.cc): combine accumulated beam
+    scores [B, K] with next-token log-probs [B, K, V], take the global
+    top-K over K*V, and return (token [B, K], parent [B, K],
+    scores [B, K], finished [B, K]).
+
+    Finished beams (``finished`` mask) are frozen: their only expansion
+    is ``end_id`` at unchanged score, so they compete with live beams
+    but never grow."""
+    import jax
+
+    B, K, V = log_probs.shape
+    if finished is None:
+        finished = jnp.zeros((B, K), bool)
+    frozen = jnp.full((V,), -jnp.inf).at[end_id].set(0.0)
+    lp = jnp.where(finished[..., None], frozen[None, None, :], log_probs)
+    total = pre_scores[..., None] + lp
+    # beam_size is the OUTPUT width (may differ from the incoming K,
+    # e.g. expanding one seed beam into beam_size candidates)
+    top, idx = jax.lax.top_k(total.reshape(B, K * V), int(beam_size))
+    parent = idx // V
+    token = idx % V
+    new_fin = jnp.take_along_axis(finished, parent, 1) | (token == end_id)
+    return token, parent, top, new_fin
+
+
+def beam_search(step_fn, bos_id, end_id, beam_size, max_len, batch_size=1,
+                vocab_size=None, length_penalty=0.0):
+    """Full beam-search decode under ONE lax.scan (reference role:
+    beam_search + beam_search_decode_op.cc backtrace, and the dygraph
+    nn BeamSearchDecoder).
+
+    ``step_fn(history, t) -> log_probs``: history [B, K, max_len+1] of
+    token ids (prefix valid through position t), returns [B, K, V]
+    next-token log-probs.  The decoded history is re-gathered by parent
+    every step, so no separate backtrace pass is needed (the TPU-native
+    replacement for the reference's LoD backtrace op).
+
+    Returns (sequences [B, K, max_len+1], scores [B, K]) sorted
+    best-first; positions past a beam's end_id are filled with end_id.
+    """
+    import jax
+
+    B, K = batch_size, beam_size
+    hist0 = jnp.full((B, K, max_len + 1), end_id, jnp.int32)
+    hist0 = hist0.at[:, :, 0].set(bos_id)
+    # only beam 0 starts live: identical beams would duplicate the top-K
+    scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, -jnp.inf)
+    scores0 = jnp.broadcast_to(scores0, (B, K)).astype(jnp.float32)
+    fin0 = jnp.zeros((B, K), bool)
+
+    def tick(carry, t):
+        hist, scores, fin = carry
+        lp = step_fn(hist, t)
+        token, parent, scores, fin = beam_search_step(
+            scores, lp, K, end_id, fin)
+        hist = jnp.take_along_axis(hist, parent[..., None], 1)
+        hist = jax.vmap(lambda h, tok, tt: h.at[:, tt].set(tok),
+                        in_axes=(0, 0, None))(hist, token, t + 1)
+        return (hist, scores, fin), None
+
+    (hist, scores, fin), _ = jax.lax.scan(
+        tick, (hist0, scores0, fin0), jnp.arange(max_len))
+    if length_penalty:
+        lengths = (hist != end_id).sum(-1).astype(jnp.float32)
+        scores = scores / jnp.power(lengths, length_penalty)
+        order = jnp.argsort(-scores, axis=1)
+        hist = jnp.take_along_axis(hist, order[..., None], 1)
+        scores = jnp.take_along_axis(scores, order, 1)
+    return hist, scores

@@ -1,0 +1,136 @@
+"""Sequence operators (reference: paddle/fluid/operators/sequence_ops/ —
+51 LoD-tensor kernels).
+
+TPU-first redesign: the reference's sequence ops run on LoD (ragged)
+tensors whose row offsets live in host metadata.  Ragged shapes cannot
+be jitted, so the TPU-native contract is PADDED DENSE + LENGTHS: every
+op takes [B, T, ...] plus lengths [B], masks arithmetic instead of
+slicing rows, and compiles to one fused vectorized program.  The
+pad/unpad pair converts between the reference's flat-concatenated
+layout and the padded one at the host boundary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+
+__all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
+           "sequence_softmax", "sequence_reverse", "sequence_expand",
+           "sequence_pool"]
+
+
+@register_op("sequence_mask")
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    """[B] lengths -> [B, maxlen] validity mask (reference
+    sequence_ops/sequence_mask_op.cc)."""
+    ln = jnp.asarray(lengths if not hasattr(lengths, "data")
+                     else lengths.data, jnp.int32)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(ln))
+    return (jnp.arange(m)[None, :] < ln[:, None]).astype(dtype)
+
+
+def sequence_pad(x, lengths, maxlen=None, pad_value=0.0):
+    """Flat-concatenated rows [sum(lengths), ...] -> padded
+    [B, maxlen, ...] (reference sequence_pad_op.cc; LoD -> dense)."""
+    x = jnp.asarray(x if not hasattr(x, "data") else x.data)
+    ln = np.asarray(lengths, np.int64)
+    m = int(maxlen) if maxlen is not None else int(ln.max())
+    offs = np.concatenate([[0], np.cumsum(ln)[:-1]])
+    # gather index per (b, t): offs[b] + min(t, len-1); padded slots are
+    # overwritten with pad_value by the mask
+    idx = offs[:, None] + np.minimum(np.arange(m)[None, :], ln[:, None] - 1)
+    out = x[jnp.asarray(idx, jnp.int32)]
+    mask = jnp.asarray(np.arange(m)[None, :] < ln[:, None])
+    shape = mask.shape + (1,) * (out.ndim - 2)
+    return jnp.where(mask.reshape(shape), out, pad_value)
+
+
+def sequence_unpad(x, lengths):
+    """Padded [B, T, ...] -> flat rows [sum(lengths), ...] (reference
+    sequence_unpad_op.cc).  Output length is data-dependent, so this is
+    a host-boundary op (eager; not jittable)."""
+    x = np.asarray(x if not hasattr(x, "data") else x.data)
+    ln = np.asarray(lengths, np.int64)
+    return np.concatenate([x[b, :ln[b]] for b in range(len(ln))], axis=0)
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(x, lengths=None):
+    """Per-row softmax over the valid prefix only (reference
+    sequence_softmax_op.cc): padded positions get probability 0."""
+    a = jnp.asarray(x if not hasattr(x, "data") else x.data)
+    if lengths is None:
+        return jax.nn.softmax(a, axis=-1)
+    ln = jnp.asarray(lengths if not hasattr(lengths, "data")
+                     else lengths.data, jnp.int32)
+    mask = jnp.arange(a.shape[1])[None, :] < ln[:, None]
+    z = jnp.where(mask, a, -jnp.inf)
+    p = jax.nn.softmax(z, axis=1)
+    return jnp.where(mask, p, 0.0)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(x, lengths=None):
+    """Reverse each row's valid prefix, keeping padding in place
+    (reference sequence_reverse_op.cc)."""
+    a = jnp.asarray(x if not hasattr(x, "data") else x.data)
+    T = a.shape[1]
+    if lengths is None:
+        return jnp.flip(a, axis=1)
+    ln = jnp.asarray(lengths if not hasattr(lengths, "data")
+                     else lengths.data, jnp.int32)
+    t = jnp.arange(T)[None, :]
+    src = jnp.where(t < ln[:, None], ln[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        a, src.reshape(src.shape + (1,) * (a.ndim - 2)), axis=1)
+
+
+def sequence_expand(x, repeats, maxlen=None):
+    """Repeat row b of x [B, ...] repeats[b] times (reference
+    sequence_expand_op.cc: expand by the ref LoD).  Static-shape form:
+    pass ``maxlen`` = total output rows under jit (sum(repeats) must
+    equal it); defaults to the host-computed sum."""
+    a = jnp.asarray(x if not hasattr(x, "data") else x.data)
+    r = jnp.asarray(repeats if not hasattr(repeats, "data")
+                    else repeats.data, jnp.int32)
+    total = int(maxlen) if maxlen is not None else int(np.sum(np.asarray(r)))
+    idx = jnp.repeat(jnp.arange(a.shape[0]), r, total_repeat_length=total)
+    return a[idx]
+
+
+@register_op("sequence_pool")
+def sequence_pool(x, pool_type="sum", lengths=None):
+    """Masked pooling over the time axis (reference sequence_pool_op.cc:
+    SUM/AVERAGE/SQRT/MAX/FIRST/LAST over each LoD row)."""
+    a = jnp.asarray(x if not hasattr(x, "data") else x.data)
+    B, T = a.shape[0], a.shape[1]
+    if lengths is None:
+        ln = jnp.full((B,), T, jnp.int32)
+    else:
+        ln = jnp.asarray(lengths if not hasattr(lengths, "data")
+                         else lengths.data, jnp.int32)
+    mask = (jnp.arange(T)[None, :] < ln[:, None])
+    mshape = mask.shape + (1,) * (a.ndim - 2)
+    mf = mask.reshape(mshape).astype(a.dtype)
+    kind = pool_type.lower()
+    if kind == "sum":
+        return (a * mf).sum(axis=1)
+    if kind in ("average", "mean", "avg"):
+        return (a * mf).sum(axis=1) / jnp.maximum(
+            ln.reshape((B,) + (1,) * (a.ndim - 2)).astype(a.dtype), 1)
+    if kind == "sqrt":
+        return (a * mf).sum(axis=1) / jnp.sqrt(jnp.maximum(
+            ln.reshape((B,) + (1,) * (a.ndim - 2)).astype(a.dtype), 1))
+    if kind == "max":
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        return jnp.where(mask.reshape(mshape), a, neg).max(axis=1)
+    if kind == "first":
+        return a[:, 0]
+    if kind == "last":
+        idx = jnp.maximum(ln - 1, 0).reshape((B, 1) + (1,) * (a.ndim - 2))
+        return jnp.take_along_axis(a, idx, axis=1)[:, 0]
+    raise ValueError(f"sequence_pool: unknown pool_type {pool_type!r}")

@@ -1,2 +1,2 @@
 """paddle_tpu.vision (parity: python/paddle/vision)."""
-from . import datasets, models, ops, transforms  # noqa: F401
+from . import datasets, detection_ops, models, ops, transforms  # noqa: F401

@@ -1,0 +1,272 @@
+"""Detection operators — the op subset that expresses the PP-YOLOE /
+SSD-class configs (VERDICT r4 item 7).
+
+Reference parity:
+  roi_align  — paddle/fluid/operators/detection/roi_align_op.cc (bilinear
+               pooling over RoI bins, `aligned` half-pixel semantics)
+  yolo_box   — paddle/fluid/operators/detection/yolo_box_op.cc (decode
+               YOLO head predictions into boxes + scores)
+  prior_box  — paddle/fluid/operators/detection/prior_box_op.cc (SSD
+               anchor generation)
+  box_coder  — paddle/fluid/operators/detection/box_coder_op.cc (SSD
+               encode/decode between priors and targets)
+
+TPU-first notes: every op is a static-shape vectorized jnp program (no
+per-RoI Python loops — sampling grids are materialized as gathers the
+XLA TPU backend tiles well).  ``roi_align``'s adaptive sampling
+(sampling_ratio <= 0) is data-dependent in the reference (ceil of the
+per-RoI bin size); under jit that is unshapeable, so it maps to the
+fixed 2-sample grid the detection configs overwhelmingly use — pass an
+explicit sampling_ratio to override.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["roi_align", "yolo_box", "prior_box", "box_coder"]
+
+
+def _arr(x, dtype=jnp.float32):
+    a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return a.astype(dtype) if dtype is not None else a
+
+
+def _bilinear(feat, y, x):
+    """Bilinear sample feat [C, H, W] at (y, x) grids [...]; out-of-range
+    samples contribute 0 (reference roi_align boundary handling)."""
+    C, H, W = feat.shape
+    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y = jnp.clip(y, 0.0, H - 1)
+    x = jnp.clip(x, 0.0, W - 1)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly, lx = y - y0, x - x0
+    hy, hx = 1.0 - ly, 1.0 - lx
+    # gather 4 corners: [C, ...grid]
+    g = lambda yy, xx: feat[:, yy, xx]
+    val = (g(y0, x0) * (hy * hx) + g(y0, x1) * (hy * lx)
+           + g(y1, x0) * (ly * hx) + g(y1, x1) * (ly * lx))
+    return val * valid.astype(feat.dtype)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoI Align (reference roi_align_op.cc; torchvision semantics).
+
+    x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2) in input-image
+    coords; boxes_num: [N] rois per image (defaults to all on image 0).
+    Returns [R, C, output_size, output_size].
+    """
+    x = _arr(x)
+    boxes = _arr(boxes)
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    s = int(sampling_ratio) if sampling_ratio and sampling_ratio > 0 else 2
+
+    if boxes_num is None:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+    else:
+        bn = _arr(boxes_num, jnp.int32)
+        batch_idx = jnp.repeat(jnp.arange(N, dtype=jnp.int32), bn,
+                               total_repeat_length=R)
+
+    offset = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale - offset
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:                      # legacy: clamp to >= 1
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+
+    # sample grid per roi: [R, ph, s] x [R, pw, s]
+    iy = (jnp.arange(ph)[None, :, None]
+          + (jnp.arange(s)[None, None, :] + 0.5) / s)
+    ix = (jnp.arange(pw)[None, :, None]
+          + (jnp.arange(s)[None, None, :] + 0.5) / s)
+    ys = y1[:, None, None] + iy * bin_h[:, None, None]   # [R, ph, s]
+    xs = x1[:, None, None] + ix * bin_w[:, None, None]   # [R, pw, s]
+    # full grid [R, ph, pw, s, s]
+    yg = ys[:, :, None, :, None]
+    xg = xs[:, None, :, None, :]
+    yg = jnp.broadcast_to(yg, (R, ph, pw, s, s))
+    xg = jnp.broadcast_to(xg, (R, ph, pw, s, s))
+
+    def one_roi(b, yg_r, xg_r):
+        feat = x[b]                                       # [C, H, W]
+        v = _bilinear(feat, yg_r, xg_r)                   # [C, ph, pw, s, s]
+        return v.mean(axis=(-1, -2))
+
+    out = jax.vmap(one_roi)(batch_idx, yg, xg)            # [R, C, ph, pw]
+    return Tensor(out) if isinstance(boxes, Tensor) else out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLO detection head (reference yolo_box_op.cc).
+
+    x: [N, A*(5+class_num), H, W]; img_size: [N, 2] (h, w); anchors:
+    flat list [a0w, a0h, a1w, ...].  Returns (boxes [N, A*H*W, 4] in
+    (x1, y1, x2, y2), scores [N, A*H*W, class_num]); predictions with
+    objectness below conf_thresh are zeroed (the op's LoD-free contract).
+    """
+    x = _arr(x)
+    img_size = _arr(img_size)
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)   # (w, h)
+    pred = x.reshape(N, A, 5 + class_num, H, W)
+
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(pred[:, :, 0]) * alpha + beta + gx) / W
+    cy = (jax.nn.sigmoid(pred[:, :, 1]) * alpha + beta + gy) / H
+    tw = jnp.exp(jnp.clip(pred[:, :, 2], -10.0, 10.0))
+    th = jnp.exp(jnp.clip(pred[:, :, 3], -10.0, 10.0))
+    input_h = downsample_ratio * H
+    input_w = downsample_ratio * W
+    bw = tw * an[None, :, 0, None, None] / input_w
+    bh = th * an[None, :, 1, None, None] / input_h
+
+    obj = jax.nn.sigmoid(pred[:, :, 4])
+    cls = jax.nn.sigmoid(pred[:, :, 5:])                  # [N,A,cls,H,W]
+    keep = (obj >= conf_thresh).astype(x.dtype)
+    scores = (cls * (obj * keep)[:, :, None]).transpose(0, 1, 3, 4, 2)
+
+    imh = img_size[:, 0][:, None, None, None]
+    imw = img_size[:, 1][:, None, None, None]
+    x1 = (cx - bw / 2) * imw
+    y1 = (cy - bh / 2) * imh
+    x2 = (cx + bw / 2) * imw
+    y2 = (cy + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+        x2 = jnp.clip(x2, 0.0, imw - 1)
+        y2 = jnp.clip(y2, 0.0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+    return (boxes.reshape(N, A * H * W, 4),
+            scores.reshape(N, A * H * W, class_num))
+
+
+def prior_box(input_hw, image_hw, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior (anchor) boxes (reference prior_box_op.cc).
+
+    input_hw: (H, W) of the feature map; image_hw: (h, w) of the image.
+    Returns (boxes [H, W, P, 4] normalized (x1, y1, x2, y2),
+    variances [H, W, P, 4]).
+    """
+    H, W = int(input_hw[0]), int(input_hw[1])
+    img_h, img_w = float(image_hw[0]), float(image_hw[1])
+    step_h = steps[0] or img_h / H
+    step_w = steps[1] or img_w / W
+
+    # expand aspect ratios like the reference (1.0 first, optional flip)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(float(ar))
+        if flip:
+            ars.append(1.0 / float(ar))
+
+    whs = []       # per-prior (half_w, half_h) in pixels
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms / 2, ms / 2))
+            if max_sizes:
+                big = np.sqrt(ms * float(max_sizes[k]))
+                whs.append((big / 2, big / 2))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+            if max_sizes:
+                big = np.sqrt(ms * float(max_sizes[k]))
+                whs.append((big / 2, big / 2))
+    wh = jnp.asarray(whs, jnp.float32)                   # [P, 2]
+    P = wh.shape[0]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+    hw_ = jnp.broadcast_to(wh[None, None, :, 0], (H, W, P))
+    hh_ = jnp.broadcast_to(wh[None, None, :, 1], (H, W, P))
+    boxes = jnp.stack([(cxg - hw_) / img_w, (cyg - hh_) / img_h,
+                       (cxg + hw_) / img_w, (cyg + hh_) / img_h], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, P, 4))
+    return boxes, var
+
+
+def box_coder(prior_box_, target_box, prior_box_var=None,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """SSD box encode/decode (reference box_coder_op.cc).
+
+    encode: target [T, 4] against priors [P, 4] -> [T, P, 4] deltas.
+    decode: deltas [T, P, 4] (or [T, 4] with axis semantics collapsed to
+    per-row priors when shapes match) -> absolute boxes.
+    prior_box_var: [P, 4] or a 4-vector; None = unit variance.
+    """
+    pb = _arr(prior_box_)
+    tb = _arr(target_box)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if prior_box_var is None:
+        var = jnp.ones((pb.shape[0], 4), jnp.float32)
+    else:
+        v = _arr(prior_box_var)
+        var = (jnp.broadcast_to(v, (pb.shape[0], 4)) if v.ndim == 1
+               else v)
+
+    if code_type in ("encode_center_size", "encode"):
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], -1) / var[None]
+        return out
+    if code_type in ("decode_center_size", "decode"):
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        d = tb * var[None]
+        cx = d[..., 0] * pw[None, :] + pcx[None, :]
+        cy = d[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(d[..., 2]) * pw[None, :]
+        h = jnp.exp(d[..., 3]) * ph[None, :]
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], -1)
+    raise ValueError(f"box_coder: unknown code_type {code_type!r}")

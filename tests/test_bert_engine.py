@@ -1,0 +1,97 @@
+"""A second architecture through HybridEngine.step with no engine edits
+(VERDICT r4 item 3): BERT-style bidirectional encoder + MLM head via
+distributed.model_adapter.BertAdapter.
+
+Reference role: fleet.distributed_model wraps ANY Layer
+(fleet_base.py:937,1043-1069) — here the engine's stage protocol carries
+a model family with different attention (bidirectional), a different
+embedding (token types + embedding LN) and a different head (MLM
+transform), under the same dp x mp x pp hybrid meshes, both pipeline
+schedules, ZeRO and the optimizer."""
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from paddle_tpu.distributed.engine import EngineConfig, HybridEngine
+from paddle_tpu.distributed.model_adapter import BertAdapter
+from paddle_tpu.models.bert import BertConfig, bert_loss
+
+CFG = BertConfig(vocab_size=256, max_seq_len=64, type_vocab_size=2,
+                 hidden=64, num_layers=4, num_heads=4, ffn_hidden=128,
+                 dtype="float32", use_flash=False, remat="nothing")
+
+
+def _mlm_batch(bs=8, seq=32, seed=0, mask_rate=0.2):
+    """MLM corruption: labels carry the original ids at masked positions,
+    -100 elsewhere; masked inputs are replaced by a [MASK]-like id."""
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, CFG.vocab_size, (bs, seq)).astype(np.int32)
+    mask = rng.rand(bs, seq) < mask_rate
+    labels = np.where(mask, tokens, -100).astype(np.int32)
+    corrupted = np.where(mask, CFG.vocab_size - 1, tokens).astype(np.int32)
+    return corrupted, labels
+
+
+def _run(engine, n=3, bs=8):
+    params, opt = engine.init(seed=0)
+    tokens, labels = _mlm_batch(bs)
+    losses = []
+    for _ in range(n):
+        params, opt, loss = engine.step(params, opt, tokens, labels,
+                                        lr=1e-3)
+        losses.append(float(loss))
+    return losses, engine.gather_params(params)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    eng = HybridEngine(BertAdapter(CFG), devices=jax.devices()[:1])
+    return _run(eng)
+
+
+def test_single_device_loss_matches_functional(baseline):
+    """Engine pp=1 path == the functional bert_loss oracle at init."""
+    eng = HybridEngine(BertAdapter(CFG), devices=jax.devices()[:1])
+    params, _ = eng.init(seed=0)
+    tokens, labels = _mlm_batch()
+    host = eng.gather_params(params)
+    ref = float(bert_loss(CFG, host, tokens, labels))
+    assert abs(baseline[0][0] - ref) < 2e-4, (baseline[0][0], ref)
+    # MLM CE near log(vocab) at init
+    assert abs(ref - np.log(CFG.vocab_size)) < 1.0
+
+
+def test_dp_mp_matches(baseline):
+    eng = HybridEngine(BertAdapter(CFG), dp=2, mp=2,
+                       devices=jax.devices()[:4])
+    losses, _ = _run(eng)
+    np.testing.assert_allclose(losses, baseline[0], atol=2e-4, rtol=1e-4)
+
+
+def test_pp_1f1b_matches(baseline):
+    eng = HybridEngine(BertAdapter(CFG), pp=2, devices=jax.devices()[:2],
+                       engine_cfg=EngineConfig(num_microbatches=4,
+                                               pipeline_schedule="1f1b"))
+    losses, _ = _run(eng)
+    np.testing.assert_allclose(losses, baseline[0], atol=2e-4, rtol=1e-4)
+
+
+def test_hybrid_dp_mp_pp_matches(baseline):
+    eng = HybridEngine(BertAdapter(CFG), dp=2, mp=2, pp=2,
+                       engine_cfg=EngineConfig(num_microbatches=2))
+    losses, params = _run(eng)
+    np.testing.assert_allclose(losses, baseline[0], atol=2e-4, rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(baseline[1]),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4)
+
+
+def test_zero3_matches(baseline):
+    eng = HybridEngine(BertAdapter(CFG), sharding=4,
+                       devices=jax.devices()[:4],
+                       engine_cfg=EngineConfig(zero_stage=3))
+    losses, _ = _run(eng)
+    np.testing.assert_allclose(losses, baseline[0], atol=2e-4, rtol=1e-4)

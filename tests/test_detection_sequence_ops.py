@@ -1,0 +1,327 @@
+"""NumPy-golden OpTests for the detection + sequence + CTC + beam-search
+pack (VERDICT r4 item 7; reference test strategy: OpTest compares each
+kernel against a hand-written numpy model).
+
+Golden oracles: scalar-loop numpy reimplementations (roi_align,
+yolo_box, box_coder, prior_box), torch.nn.functional.ctc_loss (CPU), and
+hand-computed lattices (beam search)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.loss import ctc_loss
+from paddle_tpu.ops.search import beam_search, beam_search_step
+from paddle_tpu.ops.sequence import (sequence_expand, sequence_mask,
+                                     sequence_pad, sequence_pool,
+                                     sequence_reverse, sequence_softmax,
+                                     sequence_unpad)
+from paddle_tpu.vision.detection_ops import (box_coder, prior_box,
+                                             roi_align, yolo_box)
+
+
+# ------------------------------------------------------------ roi_align
+
+
+def _roi_align_np(x, boxes, batch_idx, out_size, scale, samples, aligned):
+    """Scalar-loop golden model."""
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    out = np.zeros((R, C, out_size, out_size), np.float32)
+
+    def bil(feat, y, xx):
+        if y < -1.0 or y > H or xx < -1.0 or xx > W:
+            return np.zeros((C,), np.float32)
+        y, xx = min(max(y, 0.0), H - 1), min(max(xx, 0.0), W - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        ly, lx = y - y0, xx - x0
+        return (feat[:, y0, x0] * (1 - ly) * (1 - lx)
+                + feat[:, y0, x1] * (1 - ly) * lx
+                + feat[:, y1, x0] * ly * (1 - lx)
+                + feat[:, y1, x1] * ly * lx)
+
+    off = 0.5 if aligned else 0.0
+    for r in range(R):
+        feat = x[batch_idx[r]]
+        x1, y1, x2, y2 = boxes[r] * scale - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bh, bw = rh / out_size, rw / out_size
+        for ph in range(out_size):
+            for pw in range(out_size):
+                acc = np.zeros((C,), np.float32)
+                for iy in range(samples):
+                    for ix in range(samples):
+                        yy = y1 + (ph + (iy + 0.5) / samples) * bh
+                        xx = x1 + (pw + (ix + 0.5) / samples) * bw
+                        acc += bil(feat, yy, xx)
+                out[r, :, ph, pw] = acc / (samples * samples)
+    return out
+
+
+class TestRoiAlign:
+    @pytest.mark.parametrize("aligned", [True, False])
+    def test_matches_numpy(self, aligned):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 16, 16).astype(np.float32)
+        boxes = np.array([[1.0, 1.0, 10.0, 12.0],
+                          [0.0, 0.0, 31.0, 31.0],
+                          [4.5, 3.2, 20.0, 25.0]], np.float32)
+        boxes_num = np.array([2, 1])
+        got = np.asarray(roi_align(x, boxes, boxes_num, output_size=4,
+                                   spatial_scale=0.5, sampling_ratio=2,
+                                   aligned=aligned))
+        want = _roi_align_np(x, boxes, [0, 0, 1], 4, 0.5, 2, aligned)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_output_shape_and_jit(self):
+        import jax
+
+        x = np.zeros((1, 2, 8, 8), np.float32)
+        boxes = np.zeros((5, 4), np.float32)
+        f = jax.jit(lambda x, b: roi_align(x, b, output_size=7))
+        assert f(x, boxes).shape == (5, 2, 7, 7)
+
+
+# ------------------------------------------------------------- yolo_box
+
+
+class TestYoloBox:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        N, A, H, W, ncls = 1, 2, 3, 3, 4
+        anchors = [10, 13, 16, 30]
+        x = rng.randn(N, A * (5 + ncls), H, W).astype(np.float32)
+        img = np.array([[96, 96]], np.float32)
+        boxes, scores = yolo_box(x, img, anchors, ncls, conf_thresh=0.0,
+                                 downsample_ratio=32, clip_bbox=False)
+        boxes, scores = np.asarray(boxes), np.asarray(scores)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        p = x.reshape(N, A, 5 + ncls, H, W)
+        # check one cell by hand: anchor a=1, cell (i=2, j=1)
+        a, i, j = 1, 2, 1
+        cx = (sig(p[0, a, 0, i, j]) + j) / W * 96
+        cy = (sig(p[0, a, 1, i, j]) + i) / H * 96
+        bw = np.exp(p[0, a, 2, i, j]) * anchors[2] / (32 * W) * 96
+        bh = np.exp(p[0, a, 3, i, j]) * anchors[3] / (32 * H) * 96
+        k = a * H * W + i * W + j
+        np.testing.assert_allclose(
+            boxes[0, k], [cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                          cy + bh / 2], rtol=1e-5)
+        np.testing.assert_allclose(
+            scores[0, k], sig(p[0, a, 5:, i, j]) * sig(p[0, a, 4, i, j]),
+            rtol=1e-5)
+
+    def test_conf_thresh_zeroes(self):
+        x = np.full((1, 10, 2, 2), -10.0, np.float32)   # obj ~ 0
+        boxes, scores = yolo_box(x, np.array([[64, 64]]), [10, 13], 5,
+                                 conf_thresh=0.5)
+        assert np.all(np.asarray(boxes) == 0)
+        assert np.all(np.asarray(scores) == 0)
+
+
+# ------------------------------------------------------------ prior_box
+
+
+class TestPriorBox:
+    def test_center_and_sizes(self):
+        boxes, var = prior_box((2, 2), (32, 32), min_sizes=[8.0],
+                               max_sizes=[16.0], aspect_ratios=[2.0],
+                               flip=True)
+        boxes, var = np.asarray(boxes), np.asarray(var)
+        # priors per cell: 1 (min) + ar 2 + ar 0.5 + 1 (sqrt(min*max))
+        assert boxes.shape == (2, 2, 4, 4)
+        # cell (0,0) center = (0.5*16, 0.5*16) = (8, 8); min prior 8x8
+        np.testing.assert_allclose(
+            boxes[0, 0, 0], [(8 - 4) / 32, (8 - 4) / 32,
+                             (8 + 4) / 32, (8 + 4) / 32], rtol=1e-6)
+        # the max prior is sqrt(8*16) square
+        big = np.sqrt(8 * 16) / 2
+        np.testing.assert_allclose(
+            boxes[0, 0, 3], [(8 - big) / 32, (8 - big) / 32,
+                             (8 + big) / 32, (8 + big) / 32], rtol=1e-6)
+        np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_clip(self):
+        boxes, _ = prior_box((1, 1), (16, 16), min_sizes=[32.0], clip=True)
+        b = np.asarray(boxes)
+        assert b.min() >= 0.0 and b.max() <= 1.0
+
+
+# ------------------------------------------------------------ box_coder
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(2)
+        priors = np.array([[2, 2, 10, 10], [4, 4, 8, 12]], np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        targets = np.array([[3, 3, 9, 9], [1, 2, 7, 10]], np.float32)
+        enc = np.asarray(box_coder(priors, targets, var, "encode"))
+        assert enc.shape == (2, 2, 4)
+        dec = np.asarray(box_coder(priors, enc, var, "decode"))
+        for t in range(2):
+            for p in range(2):
+                np.testing.assert_allclose(dec[t, p], targets[t],
+                                           rtol=1e-4, atol=1e-4)
+
+    def test_encode_golden(self):
+        priors = np.array([[0, 0, 10, 10]], np.float32)
+        targets = np.array([[2, 2, 6, 8]], np.float32)
+        enc = np.asarray(box_coder(priors, targets, None, "encode"))
+        # centers: prior (5,5) wh (10,10); target (4,5) wh (4,6)
+        np.testing.assert_allclose(
+            enc[0, 0], [(4 - 5) / 10, 0.0, np.log(0.4), np.log(0.6)],
+            rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- ctc_loss
+
+
+class TestCtcLoss:
+    def _torch_ref(self, lp, labels, in_len, lab_len, reduction):
+        import torch
+        import torch.nn.functional as F
+
+        return F.ctc_loss(torch.tensor(lp), torch.tensor(labels),
+                          torch.tensor(in_len), torch.tensor(lab_len),
+                          blank=0, reduction=reduction,
+                          zero_infinity=False).numpy()
+
+    @pytest.mark.parametrize("reduction", ["none", "mean", "sum"])
+    def test_matches_torch(self, reduction):
+        rng = np.random.RandomState(0)
+        T, B, C, S = 14, 4, 7, 5
+        logits = rng.randn(T, B, C).astype(np.float32)
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        labels = rng.randint(1, C, (B, S)).astype(np.int64)
+        in_len = np.array([14, 12, 9, 14])
+        lab_len = np.array([5, 4, 2, 1])
+        got = np.asarray(ctc_loss(lp, labels.astype(np.int32), in_len,
+                                  lab_len, reduction=reduction))
+        want = self._torch_ref(lp, labels, in_len, lab_len, reduction)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_repeated_labels_skip_rule(self):
+        rng = np.random.RandomState(1)
+        T, B, C = 10, 2, 5
+        logits = rng.randn(T, B, C).astype(np.float32)
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        labels = np.array([[2, 2, 2, 3], [1, 2, 1, 2]], np.int64)
+        in_len = np.array([10, 10])
+        lab_len = np.array([4, 4])
+        got = np.asarray(ctc_loss(lp, labels.astype(np.int32), in_len,
+                                  lab_len, reduction="none"))
+        want = self._torch_ref(lp, labels, in_len, lab_len, "none")
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_grad_flows(self):
+        import jax
+
+        rng = np.random.RandomState(2)
+        T, B, C = 8, 2, 5
+        logits = rng.randn(T, B, C).astype(np.float32)
+
+        def f(logits):
+            lp = jax.nn.log_softmax(logits, -1)
+            # pure_fn: the jit/grad-traceable entry (the eager wrapper
+            # returns framework Tensors)
+            return ctc_loss.pure_fn(
+                lp, np.array([[1, 2], [3, 1]], np.int32),
+                np.array([8, 8]), np.array([2, 2]))
+
+        g = jax.grad(f)(logits)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
+# ---------------------------------------------------------- beam search
+
+
+class TestBeamSearch:
+    def test_step_freezes_finished(self):
+        import jax.numpy as jnp
+
+        pre = jnp.asarray([[0.0, -1.0]])
+        lp = jnp.log(jnp.asarray([[[0.5, 0.25, 0.25],
+                                   [0.6, 0.2, 0.2]]]))
+        fin = jnp.asarray([[True, False]])
+        tok, parent, scores, new_fin = beam_search_step(pre, lp, 2, 0,
+                                                        fin)
+        # finished beam 0 extends only with end_id at unchanged score
+        assert int(tok[0, 0]) == 0 and float(scores[0, 0]) == 0.0
+        assert bool(new_fin[0, 0])
+
+    def test_finds_better_than_greedy(self):
+        import jax
+        import jax.numpy as jnp
+
+        def step_fn(hist, t):
+            prev = jax.vmap(lambda h, tt: h[:, tt],
+                            in_axes=(0, None))(hist, t)
+            t0 = jnp.asarray([-5.0, -0.3, -0.5, -9.0])
+            after1 = jnp.asarray([-3.0, -4.0, -4.0, -9.0])
+            after2 = jnp.asarray([-0.1, -4.0, -4.0, -9.0])
+            return jnp.where((prev == 1)[..., None], after1,
+                             jnp.where((prev == 2)[..., None], after2,
+                                       t0))
+
+        seqs, scores = beam_search(step_fn, bos_id=3, end_id=0,
+                                   beam_size=2, max_len=3, batch_size=1)
+        assert abs(float(scores[0, 0]) - (-0.6)) < 1e-5
+        assert list(np.asarray(seqs[0, 0])) == [3, 2, 0, 0]
+        assert abs(float(scores[0, 1]) - (-3.3)) < 1e-5
+
+
+# ---------------------------------------------------------- sequence ops
+
+
+class TestSequenceOps:
+    def test_mask(self):
+        m = np.asarray(sequence_mask([2, 0, 3], maxlen=4, dtype="int32"))
+        np.testing.assert_array_equal(
+            m, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_pad_unpad_roundtrip(self):
+        rng = np.random.RandomState(0)
+        flat = rng.randn(6, 3).astype(np.float32)
+        lens = [2, 1, 3]
+        padded = np.asarray(sequence_pad(flat, lens, pad_value=-9.0))
+        assert padded.shape == (3, 3, 3)
+        assert np.all(padded[1, 1:] == -9.0)
+        np.testing.assert_allclose(padded[2, :3], flat[3:6])
+        back = sequence_unpad(padded, lens)
+        np.testing.assert_allclose(back, flat)
+
+    def test_softmax_masks_padding(self):
+        x = np.array([[1.0, 2.0, 3.0], [5.0, 1.0, 1.0]], np.float32)
+        p = np.asarray(sequence_softmax(x, [2, 1]))
+        np.testing.assert_allclose(p.sum(1), [1.0, 1.0], rtol=1e-6)
+        assert p[0, 2] == 0.0 and p[1, 1] == 0.0 and p[1, 0] == 1.0
+
+    def test_reverse_prefix_only(self):
+        x = np.asarray([[1, 2, 3, 0], [4, 5, 6, 7]], np.float32)
+        r = np.asarray(sequence_reverse(x, [3, 4]))
+        np.testing.assert_array_equal(r[0], [3, 2, 1, 0])
+        np.testing.assert_array_equal(r[1], [7, 6, 5, 4])
+
+    def test_expand(self):
+        x = np.asarray([[1.0], [2.0], [3.0]])
+        out = np.asarray(sequence_expand(x, [2, 0, 1]))
+        np.testing.assert_array_equal(out, [[1.0], [1.0], [3.0]])
+
+    @pytest.mark.parametrize("kind,want", [
+        ("sum", [[3.0], [4.0]]),
+        ("mean", [[1.5], [4.0]]),
+        ("max", [[2.0], [4.0]]),
+        ("first", [[1.0], [4.0]]),
+        ("last", [[2.0], [4.0]]),
+    ])
+    def test_pool(self, kind, want):
+        x = np.asarray([[[1.0], [2.0], [9.0]],
+                        [[4.0], [8.0], [8.0]]], np.float32)
+        out = np.asarray(sequence_pool(x, kind, [2, 1]))
+        np.testing.assert_allclose(out, want)

@@ -293,3 +293,41 @@ class TestFusedTransformer:
         with pytest.raises(NotImplementedError, match="cache"):
             layer(paddle.to_tensor(np.ones((1, 4, 32), np.float32)),
                   cache=("k", "v"))
+
+
+class TestAuc:
+    def test_matches_exact_rank_statistic(self):
+        """Bucketed AUC (reference metrics.py:592) vs the exact
+        Mann-Whitney rank statistic."""
+        from paddle_tpu.metric import Auc
+
+        rng = np.random.RandomState(0)
+        n = 4000
+        labels = rng.randint(0, 2, n)
+        score = np.clip(labels * 0.3 + rng.rand(n) * 0.7, 0, 1)
+        m = Auc()
+        for lo in range(0, n, 512):
+            m.update(np.stack([1 - score[lo:lo + 512],
+                               score[lo:lo + 512]], 1),
+                     labels[lo:lo + 512])
+        pos, neg = score[labels == 1], score[labels == 0]
+        exact = (sum(float(np.sum(p > neg) + 0.5 * np.sum(p == neg))
+                     for p in pos) / (len(pos) * len(neg)))
+        assert abs(m.accumulate() - exact) < 2e-3
+
+    def test_empty_and_single_class(self):
+        from paddle_tpu.metric import Auc
+
+        m = Auc()
+        assert m.accumulate() == 0.0
+        m.update(np.array([[0.3, 0.7]]), np.array([1]))
+        assert m.accumulate() == 0.0     # no negatives yet
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_non_roc_curve_rejected(self):
+        from paddle_tpu.metric import Auc
+
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="ROC"):
+            Auc(curve="PR")

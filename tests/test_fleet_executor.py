@@ -1,0 +1,143 @@
+"""Cross-process (DCN-role) pipeline runtime test (VERDICT r4 item 5):
+two REAL processes, one pipeline stage each, activations/cotangents
+streaming over the native TCPStore message bus — and the result matches
+a single-process two-stage reference run exactly.
+
+Reference: fleet_executor.h:35 / carrier.h:49 / message_bus.cc:177."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+WORKER = r"""
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet_executor import (MessageBus,
+                                                   PipelineStageExecutor)
+
+rank = int(sys.argv[1]); port = int(sys.argv[2])
+store = TCPStore("127.0.0.1", port, is_master=(rank == 0), world_size=2)
+store.add("rendezvous", 1)
+store.wait(["rendezvous"])
+bus = MessageBus(store)
+
+D = 8
+rng = np.random.RandomState(0)
+w0 = jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3)
+w1 = jnp.asarray(rng.randn(D, 1).astype(np.float32) * 0.3)
+
+def stage0(p, x):
+    return jnp.tanh(x @ p)
+
+def loss_fn(p, x, y):
+    pred = x @ p
+    return jnp.mean((pred - y) ** 2)
+
+data = rng.randn(4, 8, D).astype(np.float32)   # 4 microbatches
+target = rng.randn(4, 8, 1).astype(np.float32)
+
+if rank == 0:
+    ex = PipelineStageExecutor(stage0, w0, 0, 2, bus, lr=0.05)
+    for step in range(5):
+        ex.train_batch(list(data))
+    print("W0SUM", float(jnp.sum(ex.params)))
+else:
+    ex = PipelineStageExecutor(None, w1, 1, 2, bus, loss_fn=loss_fn,
+                               lr=0.05)
+    losses = []
+    for step in range(5):
+        losses.append(ex.train_batch(None, labels=list(target)))
+    print("LOSSES", json.dumps(losses))
+    print("W1SUM", float(jnp.sum(ex.params)))
+"""
+
+
+def _reference_losses():
+    """Single-process two-stage run with identical math."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    D = 8
+    rng = np.random.RandomState(0)
+    w0 = jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3)
+    w1 = jnp.asarray(rng.randn(D, 1).astype(np.float32) * 0.3)
+    data = rng.randn(4, 8, D).astype(np.float32)
+    target = rng.randn(4, 8, 1).astype(np.float32)
+
+    def full_loss(ws, x, y):
+        w0_, w1_ = ws
+        h = jnp.tanh(x @ w0_)
+        return jnp.mean((h @ w1_ - y) ** 2)
+
+    losses = []
+    for step in range(5):
+        per = []
+        g0 = g1 = None
+        for m in range(4):
+            l, (ga, gb) = jax.value_and_grad(
+                lambda ws: full_loss(ws, jnp.asarray(data[m]),
+                                     jnp.asarray(target[m])))((w0, w1))
+            per.append(float(l))
+            g0 = ga / 4 if g0 is None else g0 + ga / 4
+            g1 = gb / 4 if g1 is None else g1 + gb / 4
+        w0 = w0 - 0.05 * g0
+        w1 = w1 - 0.05 * g1
+        losses.append(float(np.mean(per)))
+    return losses
+
+
+def test_two_process_pipeline_matches_reference(tmp_path):
+    port = 23461
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = [subprocess.Popen([sys.executable, str(script), str(r),
+                               str(port)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for r in (0, 1)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, (out, err)
+        outs.append(out)
+    line = [l for l in outs[1].splitlines() if l.startswith("LOSSES")][0]
+    losses = json.loads(line[len("LOSSES "):])
+    ref = _reference_losses()
+    np.testing.assert_allclose(losses, ref, rtol=1e-5, atol=1e-6)
+    # training across the process boundary actually reduced the loss
+    assert losses[-1] < losses[0]
+
+
+def test_message_bus_preserves_bfloat16(tmp_path):
+    """bf16 is the engine's default activation dtype — the bus must
+    round-trip it exactly (np.savez mangles ml_dtypes into void)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet_executor import MessageBus
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    bus = MessageBus(store)
+    tree = {"a": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3),
+            "b": np.float32([1.5, 2.5])}
+    bus.send(0, 1, "t0", tree)
+    out = bus.recv(0, 1, "t0")
+    assert out["a"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["a"], np.float32),
+                                  np.asarray(tree["a"], np.float32))
+    np.testing.assert_array_equal(out["b"], tree["b"])

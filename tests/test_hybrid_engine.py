@@ -206,3 +206,29 @@ def test_bf16_opt_slots_train():
     assert all(np.isfinite(l16))
     assert l16[-1] < l16[0]
     np.testing.assert_allclose(l16, l32, rtol=0.05)
+
+
+def test_windowed_adam_with_master_matches():
+    """The fori_loop windowed optimizer path WITH a separate master slot
+    (opt_dtype != model dtype): the fresh param-dtype output buffer must
+    carry the slots' vma (caught live on gpt2-medium: invariant zeros vs
+    sharding-varying windows -> fixed-carry type error)."""
+    cfg = GPTConfig(vocab_size=256, max_seq_len=64, hidden=64,
+                    num_layers=2, num_heads=4, ffn_hidden=128,
+                    dtype="float32", use_flash=False, remat="nothing")
+
+    def run(window):
+        eng = HybridEngine(cfg, sharding=2, devices=jax.devices()[:2],
+                           engine_cfg=EngineConfig(
+                               opt_dtype="bfloat16",  # != dtype => master
+                               opt_update_window=window))
+        params, opt = eng.init(seed=0)
+        tokens, labels = _batch(4, 32)
+        losses = []
+        for _ in range(3):
+            params, opt, loss = eng.step(params, opt, tokens, labels,
+                                         lr=1e-3)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run(1 << 24), run(1024), rtol=1e-6)

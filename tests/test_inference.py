@@ -140,3 +140,35 @@ class TestModelUntouched:
         out = np.asarray(pred.run(x).data)
         err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
         assert 0 < err < 0.1
+
+
+class TestRootLinearInt8:
+    def test_model_that_is_itself_a_linear(self):
+        """ADVICE r4: a model whose ONLY Linear is the top-level layer
+        must quantize (named_sublayers defaults exclude self)."""
+        import paddle_tpu.nn as nn
+
+        paddle.seed(5)
+        model = nn.Linear(16, 4)
+        rng = np.random.RandomState(3)
+        x = rng.randn(8, 16).astype(np.float32)
+        ref = np.asarray(model(paddle.to_tensor(x)).data)
+
+        from paddle_tpu.contrib.quant import PTQ
+
+        calib = nn.Linear(16, 4)
+        calib.weight.data = model.weight.data
+        calib.bias.data = model.bias.data
+        ptq = PTQ()
+        ptq.quantize(calib)
+        for _ in range(4):
+            calib(paddle.to_tensor(rng.randn(8, 16).astype(np.float32)))
+        scales = {name: {"activation": s}
+                  for name, s in ptq.scales().items()}
+        assert "" in scales          # root observed under the empty prefix
+
+        cfg = Config().set_model(model)
+        cfg.enable_int8(scales)
+        out = np.asarray(create_predictor(cfg).run(x).data)
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert err < 0.1, err

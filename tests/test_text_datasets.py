@@ -1,0 +1,151 @@
+"""Local-archive parsers for the Conll05/Movielens/WMT corpora
+(VERDICT r4 item 8): synthetic archives built in the OFFICIAL layouts
+(conll05st-release words/props gz-in-tar, ml-1m ::-separated zip,
+wmt14 src.dict/trg.dict + mode/mode tar) drive the real parse paths."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import WMT14, WMT16, Conll05, Movielens
+
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture()
+def conll_archive(tmp_path):
+    words = "\n".join(["The", "cat", "sat", "", "Dogs", "bark", ""]) + "\n"
+    # two sentences; first has one frame (predicate 'sat'), second one
+    # frame (predicate 'bark'); column 0 = target verbs, column 1 = spans
+    props = "\n".join([
+        "-    (A0*",
+        "-    *)",
+        "sat  (V*)",
+        "",
+        "-     (A0*)",
+        "bark  (V*)",
+        "",
+    ]) + "\n"
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, Conll05.WORDS_MEMBER, gzip.compress(words.encode()))
+        _tar_add(tf, Conll05.PROPS_MEMBER, gzip.compress(props.encode()))
+    return str(path)
+
+
+class TestConll05:
+    def test_parses_frames_and_bio(self, conll_archive):
+        ds = Conll05(conll_archive)
+        assert len(ds) == 2
+        words, pred, labels = ds[0]
+        assert words == ["The", "cat", "sat"]
+        assert pred == "sat"
+        assert labels == ["B-A0", "I-A0", "B-V"]
+        words, pred, labels = ds[1]
+        assert words == ["Dogs", "bark"]
+        assert pred == "bark"
+        assert labels == ["B-A0", "B-V"]
+
+    def test_dict_mode(self, conll_archive):
+        wd = {"<unk>": 0, "The": 1, "cat": 2, "sat": 3}
+        ld = {"B-A0": 0, "I-A0": 1, "B-V": 2, "O": 3}
+        ds = Conll05(conll_archive, word_dict=wd, label_dict=ld)
+        words, pred, labels = ds[0]
+        np.testing.assert_array_equal(words, [1, 2, 3])
+        np.testing.assert_array_equal(pred, [3])
+        np.testing.assert_array_equal(labels, [0, 1, 2])
+
+    def test_missing_file_is_loud(self):
+        with pytest.raises(Exception, match="Conll05|egress|local"):
+            Conll05(None)
+
+
+@pytest.fixture()
+def ml1m_archive(tmp_path):
+    path = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Heat (1995)::Action|Crime\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::12::90210\n2::F::35::7::10001\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n1::2::3::978302109\n"
+                   "2::1::4::978301968\n2::2::2::978300275\n")
+    return str(path)
+
+
+class TestMovielens:
+    def test_feature_tuple(self, ml1m_archive):
+        tr = Movielens(ml1m_archive, mode="train", test_ratio=0.0)
+        assert len(tr) == 4
+        uid, g, age, job, mid, cats, title, rating = tr[0]
+        assert uid.shape == (1,) and mid.shape == (1,)
+        assert rating.dtype == np.float32
+        # ratings are rescaled to [-3, 5]: r*2-5
+        all_ratings = sorted(float(s[7][0]) for s in
+                             (tr[i] for i in range(4)))
+        assert all_ratings == [-1.0, 1.0, 3.0, 5.0]
+        # two categories per movie, title words dictionary-coded
+        assert cats.shape[0] == 2
+        assert title.shape[0] == 2          # "Toy Story" / "Heat"->1? no:
+        # item 0 is the first kept line (user 1, movie 1: "Toy Story")
+
+    def test_split_is_deterministic_and_disjoint(self, ml1m_archive):
+        a = Movielens(ml1m_archive, mode="train", test_ratio=0.5)
+        b = Movielens(ml1m_archive, mode="test", test_ratio=0.5)
+        c = Movielens(ml1m_archive, mode="train", test_ratio=0.5)
+        assert len(a) + len(b) == 4
+        assert len(a) == len(c)
+        for x, y in zip(a, c):
+            for xa, ya in zip(x, y):
+                np.testing.assert_array_equal(xa, ya)
+
+
+@pytest.fixture()
+def wmt_archive(tmp_path):
+    path = tmp_path / "wmt14.tgz"
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = "hello world\tbonjour monde\nhello\tbonjour\n"
+    test = "world\tmonde\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "wmt14/src.dict", src_dict.encode())
+        _tar_add(tf, "wmt14/trg.dict", trg_dict.encode())
+        _tar_add(tf, "wmt14/train/train", train.encode())
+        _tar_add(tf, "wmt14/test/test", test.encode())
+    return str(path)
+
+
+class TestWMT:
+    def test_train_ids(self, wmt_archive):
+        ds = WMT14(wmt_archive, mode="train", dict_size=100)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        # <s> hello world <e>
+        np.testing.assert_array_equal(src, [0, 3, 4, 1])
+        # <s> bonjour monde / bonjour monde <e>
+        np.testing.assert_array_equal(trg, [0, 3, 4])
+        np.testing.assert_array_equal(trg_next, [3, 4, 1])
+
+    def test_test_mode_and_unk(self, wmt_archive):
+        ds = WMT14(wmt_archive, mode="test", dict_size=100)
+        assert len(ds) == 1
+        src, trg, trg_next = ds[0]
+        np.testing.assert_array_equal(src, [0, 4, 1])
+        # dict_size cut: tiny dict maps known words, unknown -> UNK(2)
+        small = WMT14(wmt_archive, mode="test", dict_size=3)
+        s2, t2, _ = small[0]
+        np.testing.assert_array_equal(s2, [0, 2, 1])   # 'world' -> UNK
+
+    def test_wmt16_same_protocol(self, wmt_archive):
+        ds = WMT16(wmt_archive, mode="train", dict_size=100)
+        assert len(ds) == 2
