@@ -180,10 +180,13 @@ class EngineConfig:
     # one chip's HBM or not)
     master_weights: bool = None
     # fp32 working-set bound (in elements) for the optimizer update:
-    # chunks larger than this run window-by-window under lax.map so peak
-    # HLO-temp memory stays O(window) instead of O(largest leaf) — a
-    # 400M-element FFN leaf otherwise materializes 1.5 GB fp32 temps
-    opt_update_window: int = 1 << 24
+    # chunks larger than this update window-by-window (in-place
+    # fori_loop) so peak HLO-temp memory stays O(window) instead of
+    # O(largest leaf).  Default 134M: gpt2-medium's 100M-element leaves
+    # go one-shot (windowing measured ~3% step cost), GPT-1.3B's
+    # 300-400M leaves split 3-way (~2.7 GB fp32 temps, fits the 1.3B
+    # single-chip budget)
+    opt_update_window: int = 1 << 27
 
     # fp32 logits-block budget (elements) for the tied-vocab CE head:
     # above it the head runs in sequence chunks under lax.map +
@@ -1193,48 +1196,58 @@ class HybridEngine:
                     out = out + (w_new.astype(odt),)
                 return out
 
-            g_f = g.reshape(-1)
-            m_f = slots["m"].reshape(-1)
-            v_f = slots["v"].reshape(-1)
-            w_f = w_store.reshape(-1)
-            C = g_f.shape[0]
+            # the update runs NATIVELY on the [.., rows, lane] slot shape:
+            # elementwise math is shape-agnostic, and flattening the 5-d
+            # slots first would RETILE-copy every operand (T(8,128) ->
+            # 1-d tiling is a physical copy on TPU — 6 x leaf-size of
+            # pure copy traffic per step).  Only the grad chunk (born
+            # flat) and the outgoing param chunk cross layouts.
+            shape5 = slots["m"].shape
+            C = int(np.prod(shape5))
+            g5 = g.reshape(shape5)
+            m5, v5 = slots["m"], slots["v"]
+            w5 = w_store if has_master else w_store.reshape(shape5)
             W = self._adam_window(C)
             if W == C:
-                outs = adam_win(g_f, m_f, v_f, w_f)
+                outs = adam_win(g5, m5, v5, w5)
             else:
-                # window the chunk with a fori_loop of dynamic slices,
-                # updating the flat buffers IN PLACE: fp32 temps stay
+                # window along the rows axis with a fori_loop of dynamic
+                # slices, updating the buffers IN PLACE: fp32 temps stay
                 # O(window) and — unlike a pad+reshape+lax.map — no
                 # stacked copy of g/m/v/w ever materializes (measured:
                 # 6 x 768 MB of copies for a 302M-element leaf)
-                if w_f.dtype == p.dtype:
-                    w_out0 = w_f
+                wr = W // self._SLOT_LANE
+                if w5.dtype == p.dtype:
+                    w_out0 = w5
                 else:
                     # fresh output buffer must already carry the vma the
                     # windows written into it will have (fori_loop needs
                     # a fixed carry type)
                     from ..core.vma import lift_to, vma_of
 
-                    w_out0 = lift_to(jnp.zeros((C,), p.dtype),
-                                     vma_of(w_f, g_f))
-                bufs0 = (m_f, v_f, w_out0) + ((w_f,) if has_master else ())
+                    w_out0 = lift_to(jnp.zeros(shape5, p.dtype),
+                                     vma_of(w5, g5))
+                bufs0 = (m5, v5, w_out0) + ((w5,) if has_master else ())
 
                 def win_body(i, bufs):
                     # reads come from the CARRY (windows are disjoint and
                     # each is read before it is written), so the original
                     # arrays are not loop operands and XLA can update the
                     # buffers genuinely in place
-                    lo = i * W
-                    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, lo, W)
+                    lo = i * wr
+                    sl = lambda x: jax.lax.dynamic_slice_in_dim(
+                        x, lo, wr, axis=3)
                     w_src = bufs[3] if has_master else bufs[2]
-                    new = adam_win(sl(g_f), sl(bufs[0]), sl(bufs[1]),
+                    new = adam_win(sl(g5), sl(bufs[0]), sl(bufs[1]),
                                    sl(w_src))
                     return tuple(
-                        jax.lax.dynamic_update_slice_in_dim(b, n, lo, 0)
+                        jax.lax.dynamic_update_slice_in_dim(b, n, lo,
+                                                            axis=3)
                         for b, n in zip(bufs, new))
 
                 outs = jax.lax.fori_loop(0, C // W, win_body, bufs0)
-            m_new, v_new, w_param = outs[0], outs[1], outs[2]
+            m_new, v_new = outs[0], outs[1]
+            w_param = outs[2].reshape(-1)
 
             if z3:
                 # stage-3: the param stays sharded — the updated chunk IS

@@ -5,7 +5,8 @@ DistributedStrategy degrees; ``distributed_model``/``distributed_optimizer``
 wrap model+optimizer per parallel mode, and the hybrid Engine (engine.py)
 compiles the whole train step with pjit over the mesh.
 """
-from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .distributed_strategy import (DistributedStrategy,  # noqa: F401
+                                   engine_config_from_strategy)
 from .fleet_base import (  # noqa: F401
     Fleet,
     distributed_model,

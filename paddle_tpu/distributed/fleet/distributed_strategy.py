@@ -60,3 +60,27 @@ class DistributedStrategy:
     def __repr__(self):
         fields = {k: v for k, v in self.__dict__.items()}
         return f"DistributedStrategy({fields})"
+
+
+def engine_config_from_strategy(strategy, **overrides):
+    """Map a DistributedStrategy onto the HybridEngine's EngineConfig
+    (reference role: fleet.distributed_optimizer consuming the strategy
+    proto).  Covers the pipeline schedule ("1F1B"/"F-then-B" →
+    pipeline_schedule), accumulate_steps/gradient-merge, and the sharding
+    stage; anything else keeps the EngineConfig default or the explicit
+    ``overrides``."""
+    from ..engine import EngineConfig
+
+    kw = {}
+    if strategy.pipeline:
+        pc = strategy.pipeline_configs
+        kw["num_microbatches"] = int(pc.get("accumulate_steps", 1))
+        mode = str(pc.get("schedule_mode", "1F1B")).lower()
+        kw["pipeline_schedule"] = ("1f1b" if mode == "1f1b" else "gpipe")
+    if strategy.sharding:
+        kw["zero_stage"] = int(strategy.sharding_configs.get("stage", 2))
+    if strategy.gradient_merge:
+        kw["accum_steps"] = int(
+            strategy.gradient_merge_configs.get("k_steps", 1))
+    kw.update(overrides)
+    return EngineConfig(**kw)

@@ -99,12 +99,23 @@ class PipelineStageExecutor:
         self._step = 0
 
     # --------------------------------------------------------- one batch
-    def train_batch(self, microbatches, labels=None):
+    def train_batch(self, microbatches, labels=None, num_microbatches=None):
         """Run fill-drain fwd then drain bwd over the microbatch list.
         First stage feeds ``microbatches``; the last stage consumes
         ``labels`` (same length) and returns the mean loss (other ranks
-        return None)."""
-        M = len(microbatches) if microbatches is not None else len(labels)
+        return None).  Interior stages of a >=3-stage pipeline have
+        neither and must pass ``num_microbatches`` (the schedule is
+        static config, not wire traffic — same as the reference's
+        accumulate_steps)."""
+        if microbatches is not None:
+            M = len(microbatches)
+        elif labels is not None:
+            M = len(labels)
+        else:
+            assert num_microbatches, \
+                "interior stages need num_microbatches= (they receive " \
+                "neither microbatches nor labels)"
+            M = int(num_microbatches)
         t = self._step
         self._step += 1
         saved = []

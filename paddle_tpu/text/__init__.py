@@ -423,6 +423,9 @@ class WMT14(Dataset):
                            for w in ([self.START] + parts[0].split()
                                      + [self.END])]
                     trg = parts[1].split()
+                    # NOTE the asymmetric cap is reference-faithful:
+                    # wmt14.py:149-160 measures the WRAPPED source
+                    # ([<s>] + words + [<e>]) but the raw target
                     if mode == "train" and (len(src) > self.MAX_LEN or
                                             len(trg) > self.MAX_LEN):
                         continue

@@ -64,6 +64,7 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
     coords; boxes_num: [N] rois per image (defaults to all on image 0).
     Returns [R, C, output_size, output_size].
     """
+    was_tensor = isinstance(boxes, Tensor)
     x = _arr(x)
     boxes = _arr(boxes)
     N, C, H, W = x.shape
@@ -111,7 +112,7 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
         return v.mean(axis=(-1, -2))
 
     out = jax.vmap(one_roi)(batch_idx, yg, xg)            # [R, C, ph, pw]
-    return Tensor(out) if isinstance(boxes, Tensor) else out
+    return Tensor(out) if was_tensor else out
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
@@ -260,13 +261,21 @@ def box_coder(prior_box_, target_box, prior_box_var=None,
         out = jnp.stack([dx, dy, dw, dh], -1) / var[None]
         return out
     if code_type in ("decode_center_size", "decode"):
+        if axis not in (0, 1):
+            raise ValueError(f"box_coder: axis must be 0 or 1, got {axis}")
         if tb.ndim == 2:
             tb = tb[:, None, :]
+        if axis == 0:
+            # priors align with dim 0 of the deltas (reference
+            # box_coder_op.cc axis semantics): run in the axis=1 layout
+            # and transpose both ways
+            tb = tb.transpose(1, 0, 2)
         d = tb * var[None]
         cx = d[..., 0] * pw[None, :] + pcx[None, :]
         cy = d[..., 1] * ph[None, :] + pcy[None, :]
         w = jnp.exp(d[..., 2]) * pw[None, :]
         h = jnp.exp(d[..., 3]) * ph[None, :]
-        return jnp.stack([cx - w / 2, cy - h / 2,
-                          cx + w / 2 - norm, cy + h / 2 - norm], -1)
+        out = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - norm, cy + h / 2 - norm], -1)
+        return out.transpose(1, 0, 2) if axis == 0 else out
     raise ValueError(f"box_coder: unknown code_type {code_type!r}")

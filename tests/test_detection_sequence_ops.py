@@ -162,7 +162,9 @@ class TestBoxCoder:
         targets = np.array([[3, 3, 9, 9], [1, 2, 7, 10]], np.float32)
         enc = np.asarray(box_coder(priors, targets, var, "encode"))
         assert enc.shape == (2, 2, 4)
-        dec = np.asarray(box_coder(priors, enc, var, "decode"))
+        # encode emits [T, P, 4] (priors on dim 1) -> decode with axis=1
+        # (reference box_coder_op.cc: axis selects the prior-aligned dim)
+        dec = np.asarray(box_coder(priors, enc, var, "decode", axis=1))
         for t in range(2):
             for p in range(2):
                 np.testing.assert_allclose(dec[t, p], targets[t],
@@ -325,3 +327,21 @@ class TestSequenceOps:
                         [[4.0], [8.0], [8.0]]], np.float32)
         out = np.asarray(sequence_pool(x, kind, [2, 1]))
         np.testing.assert_allclose(out, want)
+
+
+class TestBoxCoderAxis:
+    def test_axis0_is_transposed_axis1(self):
+        rng = np.random.RandomState(4)
+        priors = np.abs(rng.randn(3, 4)).astype(np.float32) + \
+            np.float32([0, 0, 2, 2])
+        deltas = (rng.randn(2, 3, 4) * 0.1).astype(np.float32)
+        a1 = np.asarray(box_coder(priors, deltas, None, "decode", axis=1))
+        a0 = np.asarray(box_coder(priors, deltas.transpose(1, 0, 2), None,
+                                  "decode", axis=0))
+        np.testing.assert_allclose(a0, a1.transpose(1, 0, 2), rtol=1e-5)
+
+    def test_bad_axis_rejected(self):
+        with pytest.raises(ValueError, match="axis"):
+            box_coder(np.zeros((1, 4), np.float32),
+                      np.zeros((1, 1, 4), np.float32), None, "decode",
+                      axis=2)

@@ -141,3 +141,29 @@ class TestHybridParallelOptimizer:
         hopt.minimize((m(x) ** 2).mean())
         assert not np.allclose(np.asarray(m.weight.data), w0)
         assert m.weight.grad is None    # cleared
+
+
+class TestStrategyEngineMapping:
+    def test_schedule_and_stages_map(self):
+        """DistributedStrategy pipeline/sharding/gradient-merge fields
+        drive the HybridEngine's EngineConfig (schedule_mode '1F1B' is a
+        real schedule now, not a parity-surface string)."""
+        from paddle_tpu.distributed.fleet import (
+            DistributedStrategy, engine_config_from_strategy)
+
+        s = DistributedStrategy()
+        s.pipeline = True
+        s.pipeline_configs.update(accumulate_steps=4,
+                                  schedule_mode="F-then-B")
+        s.sharding = True
+        s.sharding_configs["stage"] = 3
+        s.gradient_merge = True
+        s.gradient_merge_configs["k_steps"] = 2
+        ec = engine_config_from_strategy(s, lr=3e-4)
+        assert ec.pipeline_schedule == "gpipe"
+        assert ec.num_microbatches == 4
+        assert ec.zero_stage == 3
+        assert ec.accum_steps == 2
+        assert ec.lr == 3e-4
+        s.pipeline_configs["schedule_mode"] = "1F1B"
+        assert engine_config_from_strategy(s).pipeline_schedule == "1f1b"
