@@ -223,11 +223,12 @@ def bench_flash_vs_xla():
 
 
 def bench_resnet(batch=32, steps=5):
-    """ResNet-50 imgs/sec: bf16 compute (AMP O2: conv/fc weights and
-    activations bf16, norms + optimizer fp32), train-mode BN, SGD-momentum
-    optimizer step included — BASELINE.md protocol item 3 (VERDICT r4
-    weak #3: fp32 fwd+bwd w/o optimizer is not comparable to any published
-    ResNet-50 training number)."""
+    """ResNet-50 imgs/sec: bf16 compute via op-level AMP (O1 autocast —
+    white-listed convs/matmuls run bf16, norms/softmax and the fp32
+    master params stay fp32), train-mode BN, SGD-momentum optimizer step
+    included — BASELINE.md protocol item 3 (VERDICT r4 weak #3: fp32
+    fwd+bwd w/o optimizer is not comparable to any published ResNet-50
+    training number)."""
     import jax
     import jax.numpy as jnp
 
@@ -242,15 +243,13 @@ def bench_resnet(batch=32, steps=5):
     labels = jnp.asarray(
         np.random.RandomState(1).randint(0, 1000, (batch,)))
 
-    def cast_amp(p):
-        # AMP O2: matrix/conv weights bf16, vectors (norm gammas/betas,
-        # biases) fp32
-        return p.astype(jnp.bfloat16) if p.ndim >= 2 else p
-
     def loss_and_buffers(params, buffers, images, labels):
-        amp_params = {k: cast_amp(v) for k, v in params.items()}
-        with model.swap_state(amp_params, buffers):
-            logits = model(paddle.Tensor(images.astype(jnp.bfloat16)))
+        # framework AMP: white-listed convs/matmuls run bf16, norms stay
+        # fp32 — the op-level autocast handles the dtype joins a blanket
+        # param cast cannot (BN emits fp32 into bf16-weight convs)
+        with model.swap_state(params, buffers), \
+                paddle.amp.auto_cast(dtype="bfloat16"):
+            logits = model(paddle.Tensor(images))
             loss = paddle.nn.functional.cross_entropy(
                 logits.astype("float32"), paddle.Tensor(labels))
             # train-mode BN mutated the buffer Tensors in place; capture

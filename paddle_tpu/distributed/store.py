@@ -181,9 +181,31 @@ class TCPStore:
         raw = self._batched("ts_mfadd", payload, max(1024, len(keys)))
         return list(raw)
 
+    def msetnx(self, keys, rows):
+        """Batched create-if-absent (rows: [n, dim] f32).  Returns
+        per-row status list: 0 created, 1 already existed.  One round
+        trip — the cold-pull initialization path (a first-touch pull of
+        a 4096-row batch otherwise pays 4096 sequential SETNX RTTs)."""
+        import struct
+
+        import numpy as np
+
+        if not keys:
+            return []
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        rows = rows.reshape(len(keys), -1)
+        rowbytes = rows.shape[1] * 4
+        payload = struct.pack("<II", len(keys), rowbytes) + b"".join(
+            struct.pack("<I", len(k.encode())) + k.encode() + r.tobytes()
+            for k, r in zip(keys, rows))
+        raw = self._batched("ts_msetnx", payload, max(1024, len(keys)))
+        return list(raw)
+
     def set_if_absent(self, key: str, value) -> bool:
         """Atomically create key=value; returns False (no write) if the
-        key already exists.  The only operation that creates PS rows."""
+        key already exists.  Row creation happens ONLY via SETNX/MSETNX
+        (both write the same deterministic init bytes, so whichever wins
+        a race the stored row is identical)."""
         if isinstance(value, str):
             value = value.encode()
         rc = self._lib.ts_setnx(self._client, key.encode(), value,

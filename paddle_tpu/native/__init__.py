@@ -66,7 +66,7 @@ def load_tcp_store_lib():
         lib.ts_setnx.restype = ctypes.c_int
         lib.ts_setnx.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.c_char_p, ctypes.c_long]
-        for fn in (lib.ts_mget, lib.ts_mfadd):
+        for fn in (lib.ts_mget, lib.ts_mfadd, lib.ts_msetnx):
             fn.restype = ctypes.c_long
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                            ctypes.c_long, ctypes.c_char_p, ctypes.c_long]

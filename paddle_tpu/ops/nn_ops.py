@@ -44,14 +44,15 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"),
     )
-    pet = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    # NOTE no preferred_element_type=f32 here: the TPU MXU accumulates
+    # partial sums in f32 for bf16 operands regardless, and the conv
+    # TRANSPOSE of a pet=f32 bf16 conv builds a mixed (f32 cotangent,
+    # bf16 weight) conv that lax rejects — AMP training hits it
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=pet,
+        feature_group_count=groups,
         precision=mxu_precision(x, weight))
-    if pet is not None:
-        out = out.astype(x.dtype)
     if bias is not None:
         shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
         out = out + bias.reshape(shape)

@@ -112,3 +112,34 @@ class TestSparseEmbedding:
         emb.apply_grads(grad)
         np.testing.assert_allclose(
             np.asarray(emb.forward([5, 9])), rows - 0.5, rtol=1e-6)
+
+
+class TestInitRows:
+    """The vectorized deterministic initializer (splitmix64 + Box-Muller
+    with XOR-separated streams)."""
+
+    def test_negative_ids_ok(self):
+        rows = PSClient._init_rows([-5, 3, -(2**40)], 8, 0.01, 0)
+        assert rows.shape == (3, 8)
+        assert np.isfinite(rows).all()
+
+    def test_padding_row_not_extreme(self):
+        """(rid=0, col=0, seed=0) must not hit the splitmix 0->0 fixed
+        point: every element stays within a sane sigma range."""
+        rows = PSClient._init_rows([0], 64, 1.0, 0)
+        assert np.abs(rows).max() < 6.0
+
+    def test_adjacent_rows_independent(self):
+        """Stream separation: row r's uniforms must not alias row r+1's
+        (additive tweaks did: mix(base + C1) IS the next row)."""
+        rows = PSClient._init_rows(list(range(512)), 32, 1.0, 0)
+        a, b = rows[:-1].ravel(), rows[1:].ravel()
+        corr = np.corrcoef(a, b)[0, 1]
+        assert abs(corr) < 0.05, corr
+        # and the distribution is roughly standard normal
+        assert abs(rows.mean()) < 0.02 and abs(rows.std() - 1.0) < 0.02
+
+    def test_single_row_matches_batch(self):
+        one = PSClient._init_row(7, 16, 0.05, 3)
+        batch = PSClient._init_rows([5, 7, 9], 16, 0.05, 3)
+        np.testing.assert_array_equal(one, batch[1])

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Component ablation profile of the gpt2-medium train step on the real
+chip (VERDICT r4 item 4: raise MFU or commit a profile showing where the
+time goes).
+
+Measures, with the same dispatch-window/sync discipline as bench.py:
+  full       — the complete train step (fwd + bwd + clip + Adam)
+  grads      — value_and_grad only (no clip/Adam/param rebuild)
+  fwd        — loss only
+  no_flash   — full step with naive XLA attention instead of pallas
+Prints one JSON line with the breakdown and derived component costs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main(steps=8, warmup=2, batch=32, seq=1024, accum=4):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.engine import EngineConfig, HybridEngine
+    from paddle_tpu.models.gpt import GPT_CONFIGS
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/root/repo/.jax_bench_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          10.0)
+    except Exception:
+        pass
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 50304, (batch, seq)).astype(np.int32)
+    labels = np.concatenate(
+        [tokens[:, 1:], np.full((batch, 1), -100)], 1).astype(np.int32)
+
+    def make_engine(use_flash=True):
+        cfg = dataclasses.replace(GPT_CONFIGS["gpt2-medium"],
+                                  use_flash=use_flash, remat="dots",
+                                  dtype="bfloat16")
+        return HybridEngine(cfg, devices=jax.devices()[:1],
+                            engine_cfg=EngineConfig(accum_steps=accum))
+
+    def time_steps(fn, sync, n=steps, w=warmup):
+        fn()                       # compile
+        sync()
+        for _ in range(w):
+            fn()
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        sync()
+        return (time.perf_counter() - t0) / n * 1e3
+
+    results = {}
+
+    # ---- full step (and reuse the engine for the sub-ablations) ----
+    eng = make_engine(True)
+    params, opt = eng.init(seed=0)
+    state = {"p": params, "o": opt, "l": None}
+
+    def full():
+        state["p"], state["o"], state["l"] = eng.step(
+            state["p"], state["o"], tokens, labels)
+
+    results["full_ms"] = time_steps(full, lambda: float(state["l"]))
+    log(f"full: {results['full_ms']:.1f} ms")
+
+    # the grads/fwd ablations don't need the optimizer state — holding
+    # its 4.3 GB alongside the grads program OOMs by ~60 MB
+    state["o"] = None
+    state["l"] = None
+
+    # ---- grads only ----
+    specs = eng.param_specs()
+
+    def grads_local(params, tokens, labels):
+        loss, g = jax.value_and_grad(eng._local_loss)(params, tokens,
+                                                      labels, None)
+        return loss, g
+
+    from jax import shard_map as _sm
+
+    gfn = jax.jit(_sm(
+        grads_local, mesh=eng.mesh,
+        in_specs=(specs, eng.batch_spec(), eng.batch_spec()),
+        out_specs=(P(), specs), check_vma=True))
+    gl = {"l": None, "g": None}
+
+    def grads():
+        gl["l"], gl["g"] = gfn(state["p"], tokens, labels)
+
+    results["grads_ms"] = time_steps(grads, lambda: float(gl["l"]))
+    log(f"grads: {results['grads_ms']:.1f} ms")
+
+    # ---- forward only ----
+    ffn = jax.jit(_sm(
+        lambda p, t, l: eng._local_loss(p, t, l, None), mesh=eng.mesh,
+        in_specs=(specs, eng.batch_spec(), eng.batch_spec()),
+        out_specs=P(), check_vma=True))
+    fl = {"l": None}
+
+    def fwd():
+        fl["l"] = ffn(state["p"], tokens, labels)
+
+    results["fwd_ms"] = time_steps(fwd, lambda: float(fl["l"]))
+    log(f"fwd: {results['fwd_ms']:.1f} ms")
+
+    # ---- naive attention full step ----
+    state.clear()
+    gl.clear()
+    fl.clear()
+    eng2 = make_engine(False)
+    p2, o2 = eng2.init(seed=0)
+    st2 = {"p": p2, "o": o2, "l": None}
+
+    def full_naive():
+        st2["p"], st2["o"], st2["l"] = eng2.step(
+            st2["p"], st2["o"], tokens, labels)
+
+    results["no_flash_ms"] = time_steps(full_naive,
+                                        lambda: float(st2["l"]))
+    log(f"no_flash: {results['no_flash_ms']:.1f} ms")
+
+    results["derived"] = {
+        "optimizer_and_clip_ms": results["full_ms"] - results["grads_ms"],
+        "backward_ms": results["grads_ms"] - results["fwd_ms"],
+        "flash_gain_ms": results["no_flash_ms"] - results["full_ms"],
+    }
+    tok = batch * seq
+    results["tokens_per_sec"] = tok / (results["full_ms"] / 1e3)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
