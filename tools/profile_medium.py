@@ -92,6 +92,11 @@ def main(steps=8, warmup=2, batch=32, seq=1024, accum=4):
 
     from jax import shard_map as _sm
 
+    # grads/fwd are measured per MICROBATCH (the step runs accum of
+    # them under a scan) — the un-chunked full batch would hold 4x the
+    # dots-remat residuals and compile-OOM
+    mb = batch // accum
+    tok_mb, lab_mb = tokens[:mb], labels[:mb]
     gfn = jax.jit(_sm(
         grads_local, mesh=eng.mesh,
         in_specs=(specs, eng.batch_spec(), eng.batch_spec()),
@@ -99,10 +104,11 @@ def main(steps=8, warmup=2, batch=32, seq=1024, accum=4):
     gl = {"l": None, "g": None}
 
     def grads():
-        gl["l"], gl["g"] = gfn(state["p"], tokens, labels)
+        gl["l"], gl["g"] = gfn(state["p"], tok_mb, lab_mb)
 
-    results["grads_ms"] = time_steps(grads, lambda: float(gl["l"]))
-    log(f"grads: {results['grads_ms']:.1f} ms")
+    results["grads_micro_ms"] = time_steps(grads, lambda: float(gl["l"]))
+    results["grads_ms"] = results["grads_micro_ms"] * accum
+    log(f"grads: {results['grads_micro_ms']:.1f} ms/micro x {accum}")
 
     # ---- forward only ----
     ffn = jax.jit(_sm(
@@ -112,10 +118,11 @@ def main(steps=8, warmup=2, batch=32, seq=1024, accum=4):
     fl = {"l": None}
 
     def fwd():
-        fl["l"] = ffn(state["p"], tokens, labels)
+        fl["l"] = ffn(state["p"], tok_mb, lab_mb)
 
-    results["fwd_ms"] = time_steps(fwd, lambda: float(fl["l"]))
-    log(f"fwd: {results['fwd_ms']:.1f} ms")
+    results["fwd_micro_ms"] = time_steps(fwd, lambda: float(fl["l"]))
+    results["fwd_ms"] = results["fwd_micro_ms"] * accum
+    log(f"fwd: {results['fwd_micro_ms']:.1f} ms/micro x {accum}")
 
     # ---- naive attention full step ----
     state.clear()
