@@ -292,6 +292,71 @@ def bench_resnet(batch=32, steps=5):
                          "compiler": f"jax {jax.__version__}"}}
 
 
+def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
+    """Serving scenario: the continuous-batching engine under a synthetic
+    Poisson arrival trace (open-loop — arrival times don't wait on the
+    engine, so queueing shows up in TTFT exactly as live traffic would).
+    Reports generated tokens/sec, TTFT/queue-wait percentiles and
+    page-pool occupancy."""
+    import dataclasses
+
+    import jax
+
+    from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
+    from paddle_tpu.serving import Engine, SamplingParams, ServingMetrics
+
+    on_tpu = jax.devices()[0].platform not in ("cpu", "gpu", "cuda")
+    name = "gpt2-small" if on_tpu else "tiny"
+    cfg = dataclasses.replace(GPT_CONFIGS[name], dtype="bfloat16")
+    params = gpt_init(cfg, jax.random.key(0))
+    eng = Engine(cfg, params, page_size=16,
+                 num_pages=2048 if on_tpu else 512, max_batch_size=8,
+                 prefill_len=min(128, cfg.max_seq_len))
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, n_requests))
+    max_prompt = min(64, cfg.max_seq_len - max_new)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           rng.randint(8, max_prompt)).tolist()
+               for _ in range(n_requests)]
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    # compile prefill+decode before the clock starts (serving steady
+    # state, not compile latency, is the metric)
+    eng.generate([prompts[0][:8]], SamplingParams(max_new_tokens=2))
+    eng.metrics = ServingMetrics()
+
+    log(f"[serving] {name}: {n_requests} requests, Poisson "
+        f"{rate_per_s}/s, max_new={max_new}")
+    t0 = time.perf_counter()
+    i = 0
+    while i < n_requests or eng.has_work():
+        now = time.perf_counter() - t0
+        while i < n_requests and arrivals[i] <= now:
+            eng.add_request(prompts[i], sp)
+            i += 1
+        if not eng.has_work():
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+            continue
+        eng.step()
+    wall = time.perf_counter() - t0
+    snap = eng.metrics.snapshot()
+    out = {
+        "model": name, "requests": n_requests, "wall_s": wall,
+        "tokens_per_sec": snap["tokens"]["generated"] / wall,
+        "ttft_ms_p50": snap["ttft_s"]["p50"] * 1e3,
+        "ttft_ms_p95": snap["ttft_s"]["p95"] * 1e3,
+        "queue_wait_ms_p50": snap["queue_wait_s"]["p50"] * 1e3,
+        "decode_token_ms_p50": snap["decode_token_s"]["p50"] * 1e3,
+        "page_occupancy_peak": snap["page_occupancy"]["peak"],
+        "preempted": snap["requests"]["preempted"],
+        "finished": snap["requests"]["finished"],
+    }
+    log(f"[serving] {out['tokens_per_sec']:.1f} tok/s, TTFT p50 "
+        f"{out['ttft_ms_p50']:.0f}ms p95 {out['ttft_ms_p95']:.0f}ms, "
+        f"pool peak {out['page_occupancy_peak']*100:.0f}%")
+    return out
+
+
 def bench_ps(rows=100_000, dim=64, batch=4096):
     """Sparse parameter-server scale check: a 100k-row table pulled and
     pushed through the PSClient in loader-sized batches, reporting
@@ -452,8 +517,10 @@ def main():
                     help="skip the gpt3-1.3b headline ladder")
     ap.add_argument("--no-flash-micro", action="store_true")
     ap.add_argument("--no-ps", action="store_true")
+    ap.add_argument("--no-serving", action="store_true")
     ap.add_argument("--section",
-                    choices=["gpt", "rung", "flash", "resnet", "ps"],
+                    choices=["gpt", "rung", "flash", "resnet", "ps",
+                             "serving"],
                     help="internal: run ONE section in-process, print "
                          "its JSON")
     ap.add_argument("--rung", type=int, default=0,
@@ -487,6 +554,9 @@ def main():
         return
     if args.section == "ps":
         print(json.dumps(bench_ps()))
+        return
+    if args.section == "serving":
+        print(json.dumps(bench_serving()))
         return
 
     # ---- orchestrator: every section in its own subprocess ----
@@ -540,6 +610,9 @@ def main():
     if not args.no_ps:
         extra["ps"] = _run_section(["--section", "ps"],
                                    timeout_s=600, tag="ps")
+    if not args.no_serving:
+        extra["serving"] = _run_section(["--section", "serving"],
+                                        timeout_s=1500, tag="serving")
 
     # ---- regression gate: >5% drop vs any prior round fails the bench
     best = prior_best()

@@ -131,3 +131,4 @@ from .profiler.timer import Benchmark  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
+from . import serving  # noqa: F401,E402

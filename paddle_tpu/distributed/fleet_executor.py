@@ -20,6 +20,17 @@ The wire is deliberately the store (not a second socket protocol): the
 rendezvous, liveness and retry semantics already exist there, and DCN
 pipeline traffic is one activation tensor per microbatch per boundary —
 bandwidth-bound, not latency-bound.
+
+Trust boundary: MessageBus payloads are pickled and unpickled VERBATIM —
+``pickle.loads`` executes arbitrary code from the wire, so every process
+with reach to the TCPStore endpoint is fully trusted.  This is the same
+cluster-trust model as the reference's brpc message bus (message_bus.cc
+deserializes protobuf-framed tensors from any peer that can connect):
+the bus is for intra-job rank-to-rank traffic INSIDE a private cluster
+network, never for user-facing or cross-tenant transport.  Deployments
+must fence the store's port (network policy / firewall) to the training
+job's ranks; anything user-facing belongs in the serving layer
+(paddle_tpu.serving), which never unpickles client bytes.
 """
 from __future__ import annotations
 

@@ -102,16 +102,30 @@ class Model:
 
     def _shard_batch(self, x, y):
         """Place the batch dp-sharded on the mesh (replicated elsewhere);
-        no-op without a mesh."""
+        no-op without a mesh.
+
+        A ragged batch (size not divisible by the dp degree — e.g. the
+        tail batch of a user-supplied DataLoader without drop_last) is
+        trimmed to the largest dp multiple, matching the reference
+        distributed sampler's drop semantics; a batch smaller than dp is
+        padded by repeating its last sample so the step still runs (the
+        few duplicated samples bias one tail step negligibly)."""
         if self._mesh is None:
             return x, y
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         dp = self._mesh.shape["dp"]
-        if x.shape[0] % dp:
-            raise ValueError(
-                f"distributed fit: batch size {x.shape[0]} must divide "
-                f"the dp mesh degree {dp}")
+        n = x.shape[0]
+        if n % dp:
+            keep = (n // dp) * dp
+            if keep:
+                x, y = x[:keep], y[:keep]
+            else:                       # batch < dp: pad with the last row
+                import numpy as _np
+
+                reps = dp - n
+                x = _np.concatenate([x] + [x[-1:]] * reps, axis=0)
+                y = _np.concatenate([y] + [y[-1:]] * reps, axis=0)
         sh = NamedSharding(self._mesh, P("dp"))
         return jax.device_put(x, sh), jax.device_put(y, sh)
 
@@ -206,7 +220,12 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=1, shuffle=True, callbacks=None, **kw):
-        """Reference: hapi/model.py:907."""
+        """Reference: hapi/model.py:907.
+
+        Under a dp mesh, a user-supplied DataLoader may yield a ragged
+        tail batch; _shard_batch trims it to the largest dp multiple
+        (or pads a smaller-than-dp batch by repeating the last sample)
+        instead of raising mid-epoch."""
         train_loader = self._loader(train_data, batch_size, shuffle)
         eval_loader = self._loader(eval_data, batch_size, False)
         cbs = _to_list(callbacks)

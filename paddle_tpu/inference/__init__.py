@@ -31,7 +31,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["Config", "PrecisionType", "create_predictor", "Predictor"]
+__all__ = ["Config", "PrecisionType", "create_predictor", "Predictor",
+           "GenerationPredictor"]
 
 
 class PrecisionType:
@@ -52,6 +53,7 @@ class Config:
         self.precision = PrecisionType.Float32
         self.model_layer = None
         self.quant_scales = None
+        self.generation = None
         self._ir_optim = True
 
     # ---- device selection (Config::EnableUseGpu analog) ----
@@ -82,6 +84,24 @@ class Config:
             with open(scales) as f:
                 scales = json.load(f)
         self.quant_scales = scales
+        return self
+
+    # ---- autoregressive generation (serving engine) ----
+    def enable_generation(self, model_config, params=None, *, page_size=16,
+                          num_pages=256, max_batch_size=4,
+                          prefill_len=None):
+        """Switch create_predictor to a GenerationPredictor: a
+        continuous-batching, paged-KV-cache generation engine
+        (paddle_tpu.serving) over the given GPTConfig.  params defaults
+        to fresh gpt_init weights; page_size/num_pages size the KV page
+        pool, max_batch_size the in-flight decode batch, prefill_len the
+        static prompt pad length."""
+        self.generation = {
+            "config": model_config, "params": params,
+            "knobs": {"page_size": page_size, "num_pages": num_pages,
+                      "max_batch_size": max_batch_size,
+                      "prefill_len": prefill_len},
+        }
         return self
 
     # ---- model source for rebuild-precision paths ----
@@ -264,6 +284,39 @@ class Predictor:
     __call__ = run
 
 
-def create_predictor(config: Config) -> Predictor:
-    """paddle_infer.create_predictor parity."""
+class GenerationPredictor:
+    """create_predictor result when Config.enable_generation was called:
+    autoregressive serving over the continuous-batching engine.
+
+    ``generate(prompts, sampling)`` is the batch entry (token-id lists in,
+    generated token-id lists out); ``add_request``/``step`` expose the
+    engine's incremental scheduler for streaming callers; ``metrics()``
+    snapshots the serving counters/histograms (TTFT, queue wait,
+    per-token decode time, page-pool occupancy)."""
+
+    def __init__(self, config: Config):
+        from ..serving import Engine
+
+        gen = config.generation
+        self.config = config
+        self.engine = Engine(gen["config"], gen["params"], **gen["knobs"])
+
+    def generate(self, prompts, sampling=None):
+        return self.engine.generate(prompts, sampling)
+
+    def add_request(self, prompt, sampling=None):
+        return self.engine.add_request(prompt, sampling)
+
+    def step(self):
+        return self.engine.step()
+
+    def metrics(self):
+        return self.engine.metrics.snapshot()
+
+
+def create_predictor(config: Config):
+    """paddle_infer.create_predictor parity; generation-enabled configs
+    build the serving-engine predictor instead."""
+    if config.generation is not None:
+        return GenerationPredictor(config)
     return Predictor(config)

@@ -114,7 +114,10 @@ def _sds(shape, dtype, *like):
     is elementwise in the device dimension, so outputs vary over every mesh
     axis any input does (pallas does not validate this itself — an
     under-declared vma would silently drop AD's psums downstream)."""
-    vmas = [getattr(jax.typeof(x), "vma", None) for x in like]
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:            # older jax: no vma tracking, plain struct
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vmas = [getattr(typeof(x), "vma", None) for x in like]
     if all(v is None for v in vmas):
         return jax.ShapeDtypeStruct(shape, dtype)
     vma = frozenset().union(*[v for v in vmas if v is not None])

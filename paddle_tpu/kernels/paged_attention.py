@@ -1,0 +1,181 @@
+"""Paged-attention decode kernel — ragged single-token attention over a
+block-paged KV cache.
+
+The serving engine (paddle_tpu/serving) stores K/V in fixed-size pages so
+sequences of very different lengths share one physical pool without
+padding ("Ragged Paged Attention", arXiv:2604.15464 — the TPU analog of
+vLLM's PagedAttention).  At decode each sequence contributes ONE query
+token; its keys/values live scattered across the pages named by its page
+table.  This kernel gathers those pages and masks by the per-sequence
+length, so a ragged batch runs as one static-shape program.
+
+Two implementations with one contract:
+
+- ``_paged_attention_ref`` — pure-jnp gather + fp32 softmax.  Serves CPU
+  tests and is the numerics oracle.
+- the Pallas kernel — grid (batch, pages_per_seq); the page table and
+  sequence lengths ride in scalar-prefetch (PrefetchScalarGridSpec) so
+  the BlockSpec index_map DMAs exactly the pages each sequence owns.
+  Page steps are the innermost (sequential) grid axis; VMEM scratch
+  carries the online-softmax state across them, flash-attention style.
+
+Layouts:
+  q            [B, H, hd]           one query token per sequence
+  k/v_pages    [P, page_size, H, hd] the shared page pool (one layer)
+  page_tables  [B, max_pages] int32  physical page id per logical page
+  seq_lens     [B] int32             valid kv tokens (0 = inactive slot)
+Returns [B, H, hd] in q.dtype; inactive slots (seq_len 0) return zeros.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import flag
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+__all__ = ["paged_attention", "paged_attention_available"]
+
+_NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform not in ("cpu", "gpu", "cuda")
+    except Exception:
+        return False
+
+
+def paged_attention_available():
+    return _PALLAS_OK
+
+
+# ---------------------------------------------------------------- reference
+
+
+def _paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens, scale):
+    """Gather-then-mask oracle: [B, max_kv] dense view of the pages."""
+    B = q.shape[0]
+    _, page_size, H, hd = k_pages.shape
+    max_pages = page_tables.shape[1]
+    k = jnp.take(k_pages, page_tables, axis=0)      # [B, M, ps, H, hd]
+    v = jnp.take(v_pages, page_tables, axis=0)
+    k = k.reshape(B, max_pages * page_size, H, hd)
+    v = v.reshape(B, max_pages * page_size, H, hd)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    t = jnp.arange(max_pages * page_size)
+    s = jnp.where(t[None, None, :] < seq_lens[:, None, None], s, _NEG_INF)
+    # fp32 softmax; a fully-masked row (inactive slot) yields uniform junk —
+    # zero it below rather than divide by 0
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
+    out = jnp.where((seq_lens > 0)[:, None, None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+# ------------------------------------------------------------------- kernel
+
+
+def _decode_kernel(tbl_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, page_size, num_pages):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    seq_len = len_ref[b]
+    start = j * page_size
+
+    @pl.when(start < seq_len)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [H, hd]
+        k = kp_ref[0].astype(jnp.float32)           # [ps, H, hd]
+        v = vp_ref[0].astype(jnp.float32)
+        # s[h, t] = q[h, :] . k[t, h, :]  (batch over heads)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, ps]
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        m_prev = m_ref[:]                            # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # [H, ps]
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # acc[h, d] += p[h, :] . v[:, h, d]
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(j == num_pages - 1)
+    def _final():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
+                            scale, interpret):
+    B, H, hd = q.shape
+    _, page_size, _, _ = k_pages.shape
+    max_pages = page_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, H, hd),
+                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, H, hd),
+                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               page_size=page_size, num_pages=max_pages)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=interpret,
+    )(page_tables, seq_lens, q, k_pages, v_pages)
+
+
+# -------------------------------------------------------------- public API
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, scale=None):
+    """Single-token decode attention over a paged KV cache (see module
+    docstring for layouts).  Routes to the Pallas kernel on TPU; the jnp
+    gather path elsewhere (identical contract, fp32 softmax in both)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    page_tables = page_tables.astype(jnp.int32)
+    seq_lens = seq_lens.astype(jnp.int32)
+    if _PALLAS_OK and (_on_tpu() or flag("tpu_interpret_pallas")):
+        return _paged_attention_kernel(q, k_pages, v_pages, page_tables,
+                                       seq_lens, scale,
+                                       interpret=not _on_tpu())
+    return _paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens,
+                                scale)

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
-           "gpt_param_specs", "GPT", "GPT_CONFIGS"]
+           "gpt_param_specs", "gpt_prefill", "gpt_decode_step", "GPT",
+           "GPT_CONFIGS"]
 
 
 @dataclasses.dataclass(unsafe_hash=True)
@@ -182,12 +183,14 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
+def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None, return_kv=False):
     """One transformer block: pre-LN attention + MLP (dense or MoE).
     Returns (x, aux) where aux is the MoE load-balance loss (0 for dense).
     bp holds this layer's slice of the stacked block params.  dropout_key
     enables residual dropout (reference: resid_pdrop on the attention
-    projection and the FFN output)."""
+    projection and the FFN output).  return_kv=True additionally returns
+    this layer's k/v as [B, S, H, hd] (token-major — the page layout the
+    serving KV cache stores) for prefill cache population."""
     B, S, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     k_attn = k_ffn = None
@@ -199,9 +202,10 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
     # qkv columns are head-major [H, 3, hd] so a TP shard of the columns is
     # a whole group of heads (keeps engine.py mp splits layout-compatible)
     qkv = qkv.reshape(B, S, H, 3, hd)
+    k_tm, v_tm = qkv[:, :, :, 1], qkv[:, :, :, 2]    # token-major [B,S,H,hd]
     q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)
-    k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
-    v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
+    k = k_tm.transpose(0, 2, 1, 3)
+    v = v_tm.transpose(0, 2, 1, 3)
 
     attn_out = None
     if cfg.use_flash:
@@ -230,11 +234,14 @@ def gpt_block(cfg: GPTConfig, bp, x, dropout_key=None):
              "down_w": bp["down_w"], "down_b": bp["down_b"]},
             h, top_k=cfg.moe_top_k,
             capacity_factor=cfg.moe_capacity_factor)
-        return x + _dropout(y, cfg.dropout, k_ffn), aux
+        out = x + _dropout(y, cfg.dropout, k_ffn)
+        return (out, aux, k_tm, v_tm) if return_kv else (out, aux)
     h = jnp.einsum("bsd,df->bsf", h, bp["up_w"]) + bp["up_b"]
     h = jax.nn.gelu(h, approximate=True)
     h = jnp.einsum("bsf,fd->bsd", h, bp["down_w"]) + bp["down_b"]
-    return x + _dropout(h, cfg.dropout, k_ffn), jnp.zeros((), jnp.float32)
+    out = x + _dropout(h, cfg.dropout, k_ffn)
+    aux = jnp.zeros((), jnp.float32)
+    return (out, aux, k_tm, v_tm) if return_kv else (out, aux)
 
 
 def gpt_forward(cfg: GPTConfig, params, tokens, *, blocks=None,
@@ -306,6 +313,131 @@ def gpt_loss(cfg: GPTConfig, params, tokens, labels=None, dropout_key=None):
         # independent of depth, matching the engine's normalization)
         ce = ce + cfg.moe_aux_weight * aux / cfg.num_layers
     return ce
+
+
+# -------------------------------------------------- KV-cache decode path
+#
+# The serving engine (paddle_tpu/serving) generates autoregressively with a
+# block-paged KV cache instead of full-sequence recompute.  Two entry
+# points, each with STATIC shapes so each compiles exactly once:
+#
+#   gpt_prefill     — run the full prompt (padded to a fixed length) with
+#                     the training attention path and scatter every
+#                     layer's K/V into the cache pages; returns the
+#                     next-token logits at each sequence's last position.
+#   gpt_decode_step — one token per sequence: append its K/V to the pages
+#                     and attend over the pages via the paged-attention
+#                     kernel (ragged lengths, masked per sequence).
+#
+# Pages are stacked [L, P, page_size, H, hd] so the layer loop stays a
+# lax.scan (pages ride as per-layer xs/ys), mirroring gpt_forward.
+
+
+def _paged_write(pages, page_idx, slot_idx, vals):
+    """Scatter vals [B, ..., H, hd] into pages [P, ps, H, hd] at
+    (page_idx, slot_idx); indices already routed out-of-bounds for
+    masked-out positions, which mode="drop" discards."""
+    return pages.at[page_idx, slot_idx].set(vals.astype(pages.dtype),
+                                            mode="drop")
+
+
+def gpt_prefill(cfg: GPTConfig, params, tokens, seq_lens, k_pages, v_pages,
+                page_tables):
+    """Prompt pass: tokens [B, S] (right-padded; valid lengths seq_lens
+    [B]), pages [L, P, ps, H, hd], page_tables [B, max_pages].  Returns
+    (logits [B, V] at each sequence's last valid position, k_pages,
+    v_pages).  The attention math is gpt_forward's (causal, flash when
+    available), so positions < seq_len are unaffected by padding."""
+    B, S = tokens.shape
+    P = k_pages.shape[1]
+    page_size = k_pages.shape[2]
+    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:S]
+    x = x.astype(cfg.jdtype())
+
+    pos = jnp.arange(S)
+    page_idx = jnp.take(page_tables, pos // page_size, axis=1)     # [B, S]
+    slot_idx = jnp.broadcast_to((pos % page_size)[None, :], (B, S))
+    valid = pos[None, :] < seq_lens[:, None]
+    safe_page = jnp.where(valid, page_idx, P)          # OOB => dropped
+
+    def body(x, xs):
+        bp, kp, vp = xs
+        x, _, k, v = gpt_block(cfg, bp, x, return_kv=True)
+        kp = _paged_write(kp, safe_page, slot_idx, k)
+        vp = _paged_write(vp, safe_page, slot_idx, v)
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["blocks"], k_pages, v_pages))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    last = x[jnp.arange(B), jnp.maximum(seq_lens - 1, 0)]          # [B, D]
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bd,vd->bv", last, params["wte"])
+    else:
+        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"])
+    return logits, k_pages, v_pages
+
+
+def gpt_decode_step(cfg: GPTConfig, params, tokens, positions, seq_lens,
+                    k_pages, v_pages, page_tables):
+    """One decode step: tokens [B] (the last sampled token per sequence),
+    positions [B] (its 0-based position), seq_lens [B] = positions + 1
+    for active slots and 0 for inactive ones (inactive slots write
+    nothing and return garbage logits the engine ignores).  Returns
+    (logits [B, V], k_pages, v_pages)."""
+    B = tokens.shape[0]
+    H, hd, D = cfg.num_heads, cfg.head_dim, cfg.hidden
+    P = k_pages.shape[1]
+    page_size = k_pages.shape[2]
+
+    x = jnp.take(params["wte"], tokens, axis=0) + \
+        jnp.take(params["wpe"], positions, axis=0)
+    x = x.astype(cfg.jdtype())                                     # [B, D]
+
+    active = seq_lens > 0
+    page_of_pos = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    safe_page = jnp.where(active, page_of_pos, P)
+    slot_idx = positions % page_size
+
+    from ..kernels.paged_attention import paged_attention
+
+    def body(x, xs):
+        bp, kp, vp = xs
+        h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+        qkv = jnp.einsum("bd,de->be", h, bp["qkv_w"]) + bp["qkv_b"]
+        qkv = qkv.reshape(B, H, 3, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]     # [B, H, hd]
+        kp = _paged_write(kp, safe_page, slot_idx, k)
+        vp = _paged_write(vp, safe_page, slot_idx, v)
+        attn = paged_attention(q, kp, vp, page_tables, seq_lens)
+        attn = attn.reshape(B, D).astype(x.dtype)
+        x = x + jnp.einsum("bd,de->be", attn, bp["proj_w"]) + bp["proj_b"]
+
+        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+        if cfg.moe_experts:
+            from ..distributed.moe import moe_layer
+
+            y, _ = moe_layer(
+                {"gate_w": bp["gate_w"], "up_w": bp["up_w"],
+                 "up_b": bp["up_b"], "down_w": bp["down_w"],
+                 "down_b": bp["down_b"]},
+                h[:, None, :], top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor)
+            return x + y[:, 0], (kp, vp)
+        h = jnp.einsum("bd,df->bf", h, bp["up_w"]) + bp["up_b"]
+        h = jax.nn.gelu(h, approximate=True)
+        h = jnp.einsum("bf,fd->bd", h, bp["down_w"]) + bp["down_b"]
+        return x + h, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (params["blocks"], k_pages, v_pages))
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bd,vd->bv", x, params["wte"])
+    else:
+        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"])
+    return logits, k_pages, v_pages
 
 
 def gpt_num_params(cfg: GPTConfig):

@@ -1,0 +1,14 @@
+"""paddle_tpu.serving — continuous-batching LLM serving on TPU.
+
+The production tail of the inference stack (the reference grew
+paddle/fluid/inference the same way): a paged KV cache
+(:mod:`kv_cache`), a continuous-batching scheduler (:mod:`engine`) over
+the paged-attention decode kernel (kernels/paged_attention.py), and a
+serving metrics registry (:mod:`metrics`).  ``inference.Config
+.enable_generation()`` + ``create_predictor`` expose it through the
+predictor API; ``bench.py --section serving`` measures tokens/sec and
+TTFT under a Poisson arrival trace.
+"""
+from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
+from .kv_cache import PagedKVCache  # noqa: F401
+from .metrics import Counter, Gauge, Histogram, ServingMetrics  # noqa: F401

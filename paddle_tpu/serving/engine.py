@@ -1,0 +1,352 @@
+"""Continuous-batching generation engine.
+
+The serving-side counterpart of the training HybridEngine: requests enter
+a FIFO admission queue, prefill and decode run as two statically-shaped
+jitted programs (each compiles exactly once), and the in-flight decode
+batch admits new requests the moment slots and KV pages free up — no
+generation-long batch barrier (Orca-style continuous batching, the
+scheduling model vLLM/TPU serving stacks converged on).
+
+Phases per ``step()``:
+  1. admit — pop the queue head while a batch slot AND enough KV pages
+     for its prompt exist; run prefill (writes the prompt's K/V into
+     pages, samples the first token — TTFT).
+  2. decode — one token for every running sequence via the paged-
+     attention kernel; sample; retire finished sequences and free their
+     pages.
+  3. gauges — page-pool occupancy into the metrics registry.
+
+Admission control: requests that can NEVER fit (prompt + max_new_tokens
+over the model's max_seq_len, or more pages than the whole pool) are
+rejected at submit with Request.state == REJECTED — the engine's
+graceful-overload contract.  Requests that merely can't fit *now* stay
+queued.  If decode outgrows the pool mid-flight (admission is
+optimistic), the youngest running sequence is preempted back to the
+queue head and recomputed later — memory pressure degrades throughput,
+never correctness.
+
+Sampling is host-side (greedy / temperature / top-k / top-p) with a
+per-request numpy Generator seeded at submit, so outputs are
+deterministic for a fixed seed regardless of batch composition.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import GPTConfig, gpt_decode_step, gpt_init, gpt_prefill
+from ..profiler.profiler import RecordEvent
+from .kv_cache import PagedKVCache
+from .metrics import ServingMetrics
+
+__all__ = ["SamplingParams", "Request", "RequestState", "Engine"]
+
+
+class RequestState:
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """temperature == 0 is greedy (argmax); top_k/top_p only apply when
+    sampling.  stop_token_ids end generation (the stop token is kept in
+    the output, reason "stop"); max_new_tokens caps it (reason "length")."""
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    stop_token_ids: tuple = ()
+
+
+@dataclasses.dataclass
+class Request:
+    id: int
+    prompt: list
+    sampling: SamplingParams
+    state: str = RequestState.QUEUED
+    tokens: list = dataclasses.field(default_factory=list)  # prompt + output
+    finish_reason: str = None
+    t_submit: float = 0.0
+    t_admitted: float = None
+    t_first_token: float = None
+    t_finished: float = None
+    _rng: object = None
+
+    @property
+    def output(self):
+        return self.tokens[len(self.prompt):]
+
+    def _reset_for_recompute(self):
+        """Preemption rewinds to the prompt; the reseeded rng replays the
+        exact same draws, so a preempted request's final output is
+        identical to its uninterrupted one."""
+        self.tokens = list(self.prompt)
+        self.state = RequestState.QUEUED
+        self._rng = np.random.default_rng(self.sampling.seed)
+
+
+class Engine:
+    """Continuous-batching generation over a paged KV cache.
+
+    cfg/params: the GPT model (params default to gpt_init — useful for
+    benches and tests).  page_size/num_pages size the KV pool;
+    max_batch_size fixes the decode batch (static shape); prefill_len
+    fixes the prompt pad length (static shape, default cfg.max_seq_len).
+    """
+
+    def __init__(self, cfg: GPTConfig, params=None, *, page_size=16,
+                 num_pages=256, max_batch_size=4, prefill_len=None):
+        self.cfg = cfg
+        self.params = params if params is not None else gpt_init(cfg)
+        self.page_size = page_size
+        self.max_batch_size = max_batch_size
+        self.prefill_len = min(prefill_len or cfg.max_seq_len,
+                               cfg.max_seq_len)
+        self.cache = PagedKVCache(
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim, num_pages=num_pages, page_size=page_size,
+            max_seq_len=cfg.max_seq_len, dtype=cfg.jdtype())
+        self.metrics = ServingMetrics()
+        self._queue = deque()
+        self._slots = [None] * max_batch_size
+        self._just_finished = []
+        self._admit_seq = 0                 # admission order, for preemption
+        self._next_id = 0
+        # donation chains the page buffers through steps; XLA:CPU can't
+        # donate and warns, so only donate on accelerators
+        donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        cfg_ = cfg
+
+        def _prefill(params, k_pages, v_pages, tokens, seq_lens, tables):
+            return gpt_prefill(cfg_, params, tokens, seq_lens, k_pages,
+                               v_pages, tables)
+
+        def _decode(params, k_pages, v_pages, tokens, positions, seq_lens,
+                    tables):
+            return gpt_decode_step(cfg_, params, tokens, positions,
+                                   seq_lens, k_pages, v_pages, tables)
+
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
+        self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+
+    # ------------------------------------------------------------- submit
+    def add_request(self, prompt, sampling: SamplingParams = None):
+        """Queue a prompt (list of token ids).  Returns the Request;
+        state is REJECTED immediately when it can never be served."""
+        sampling = sampling or SamplingParams()
+        req = Request(id=self._next_id, prompt=list(prompt),
+                      sampling=sampling, t_submit=time.perf_counter())
+        self._next_id += 1
+        req.tokens = list(req.prompt)
+        req._rng = np.random.default_rng(sampling.seed)
+        self.metrics.requests_submitted.inc()
+
+        total = len(req.prompt) + sampling.max_new_tokens
+        reason = None
+        if not req.prompt:
+            reason = "empty prompt"
+        elif len(req.prompt) > self.prefill_len:
+            reason = (f"prompt length {len(req.prompt)} exceeds "
+                      f"prefill_len {self.prefill_len}")
+        elif total > self.cfg.max_seq_len:
+            reason = (f"prompt + max_new_tokens = {total} exceeds "
+                      f"max_seq_len {self.cfg.max_seq_len}")
+        elif self.cache.pages_for(total) > self.cache.num_pages:
+            reason = (f"{total} tokens need "
+                      f"{self.cache.pages_for(total)} pages; the pool has "
+                      f"{self.cache.num_pages} — page pool exhausted")
+        if reason is not None:
+            req.state = RequestState.REJECTED
+            req.finish_reason = reason
+            self.metrics.requests_rejected.inc()
+            return req
+        self._queue.append(req)
+        return req
+
+    # -------------------------------------------------------------- admit
+    def _free_slot(self):
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return None
+
+    def _try_admit(self):
+        while self._queue:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            req = self._queue[0]
+            # optimistic admission: pages for the prompt + first new token
+            if not self.cache.allocate(req.id, len(req.prompt) + 1):
+                return                       # FIFO: no queue-jumping
+            self._queue.popleft()
+            now = time.perf_counter()
+            req.state = RequestState.RUNNING
+            req.t_admitted = now
+            req._admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self._slots[slot] = req
+            self.metrics.requests_admitted.inc()
+            self.metrics.queue_wait.observe(now - req.t_submit)
+            self._prefill(req)
+
+    def _prefill(self, req):
+        n = len(req.prompt)
+        toks = np.zeros((1, self.prefill_len), np.int32)
+        toks[0, :n] = req.prompt
+        tables = np.asarray([self.cache.page_table(req.id)], np.int32)
+        with RecordEvent("serving::prefill"):
+            logits, k, v = self._prefill_fn(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.asarray(toks), jnp.asarray([n], jnp.int32),
+                jnp.asarray(tables))
+            logits = np.asarray(logits)
+        self.cache.k_pages, self.cache.v_pages = k, v
+        self.metrics.prefill_tokens.inc(n)
+        tok = self._sample_token(logits[0], req)
+        req.tokens.append(tok)
+        req.t_first_token = time.perf_counter()
+        self.metrics.ttft.observe(req.t_first_token - req.t_submit)
+        self.metrics.tokens_generated.inc()
+        self._maybe_finish(req)
+
+    # -------------------------------------------------------------- decode
+    def _running(self):
+        return [r for r in self._slots if r is not None]
+
+    def _preempt(self, req):
+        """Free req's pages and push it back to the queue head for
+        recompute (memory pressure, never an error)."""
+        self.cache.free(req.id)
+        self._slots[self._slots.index(req)] = None
+        req._reset_for_recompute()
+        self._queue.appendleft(req)
+        self.metrics.requests_preempted.inc()
+
+    def _ensure_capacity(self):
+        """Every running sequence needs a page slot for the token decode
+        is about to write; preempt youngest-first when the pool runs dry."""
+        for req in sorted(self._running(), key=lambda r: r._admit_seq):
+            if req not in self._slots:
+                continue                     # already preempted this pass
+            while not self.cache.extend(req.id, len(req.tokens)):
+                victim = max(self._running(), key=lambda r: r._admit_seq)
+                self._preempt(victim)
+                if victim is req:
+                    break
+
+    def _decode_once(self):
+        self._ensure_capacity()
+        running = self._running()
+        if not running:
+            return
+        B = self.max_batch_size
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        seq_lens = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.cache.max_pages_per_seq), np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tokens[i] = req.tokens[-1]
+            positions[i] = len(req.tokens) - 1
+            seq_lens[i] = len(req.tokens)
+            tables[i] = self.cache.page_table(req.id)
+        t0 = time.perf_counter()
+        with RecordEvent("serving::decode"):
+            logits, k, v = self._decode_fn(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seq_lens), jnp.asarray(tables))
+            logits = np.asarray(logits)
+        self.cache.k_pages, self.cache.v_pages = k, v
+        dt = time.perf_counter() - t0
+        n_active = len(running)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tok = self._sample_token(logits[i], req)
+            req.tokens.append(tok)
+            if req.t_first_token is None:
+                req.t_first_token = time.perf_counter()
+            self.metrics.tokens_generated.inc()
+            self.metrics.decode_token.observe(dt / n_active)
+            self._maybe_finish(req)
+
+    # ------------------------------------------------------------ sampling
+    def _sample_token(self, logits_row, req):
+        sp = req.sampling
+        logits = np.asarray(logits_row, np.float64)
+        if sp.temperature <= 0.0:
+            return int(np.argmax(logits))
+        logits = logits / sp.temperature
+        if sp.top_k and sp.top_k < logits.size:
+            kth = np.partition(logits, -sp.top_k)[-sp.top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        probs = np.exp(logits - np.max(logits))
+        probs = probs / probs.sum()
+        if sp.top_p < 1.0:
+            order = np.argsort(-probs)
+            cum = np.cumsum(probs[order])
+            # smallest prefix reaching top_p (always keep the first)
+            cut = int(np.searchsorted(cum, sp.top_p)) + 1
+            mask = np.zeros_like(probs)
+            mask[order[:cut]] = 1.0
+            probs = probs * mask
+            probs = probs / probs.sum()
+        return int(req._rng.choice(probs.size, p=probs))
+
+    # ------------------------------------------------------------- finish
+    def _maybe_finish(self, req):
+        sp = req.sampling
+        reason = None
+        if req.tokens[-1] in sp.stop_token_ids:
+            reason = "stop"
+        elif len(req.output) >= sp.max_new_tokens:
+            reason = "length"
+        elif len(req.tokens) >= self.cfg.max_seq_len:
+            reason = "length"
+        if reason is None:
+            return
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.t_finished = time.perf_counter()
+        self.cache.free(req.id)
+        if req in self._slots:
+            self._slots[self._slots.index(req)] = None
+        self.metrics.requests_finished.inc()
+        self._just_finished.append(req)
+
+    # --------------------------------------------------------------- drive
+    def has_work(self):
+        return bool(self._queue) or any(r is not None for r in self._slots)
+
+    def step(self):
+        """One scheduler iteration: admit, decode one token for the batch,
+        update gauges.  Returns requests that finished this step."""
+        self._try_admit()
+        self._decode_once()
+        self.metrics.page_occupancy.set(self.cache.occupancy())
+        done, self._just_finished = self._just_finished, []
+        return done
+
+    def generate(self, prompts, sampling=None):
+        """Batch convenience: submit all prompts, drive the scheduler to
+        completion, return each request's generated tokens (submit
+        order; rejected requests yield [])."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling] * len(prompts)
+        reqs = [self.add_request(p, s) for p, s in zip(prompts, sampling)]
+        while self.has_work():
+            self.step()
+        return [r.output for r in reqs]

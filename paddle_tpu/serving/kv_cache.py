@@ -1,0 +1,152 @@
+"""Block-paged KV cache — the physical memory manager behind the serving
+engine.
+
+vLLM's PagedAttention memory model on TPU (arXiv:2604.15464): K/V live in
+fixed-size pages drawn from one shared pool, a per-sequence page table
+maps logical token positions to physical pages, and sequences of wildly
+different lengths share the pool with at most page_size-1 slots of waste
+each.  The pool is a single stacked array [L, P, page_size, H, hd]
+(layer-major so the model's lax.scan over layers consumes it as per-layer
+xs/ys), bf16 by default.
+
+Host-side bookkeeping (free list, page tables) is plain Python — it sits
+on the scheduler path, not the device path; the device only ever sees the
+dense page arrays plus int32 tables the engine builds per step.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Page pool + per-sequence page tables with alloc/free/defrag.
+
+    The arrays (`k_pages`/`v_pages`) are functional: jitted model steps
+    take them as inputs and return updated copies; the engine assigns the
+    results back.  Bookkeeping methods never touch the arrays except
+    ``defrag`` (a gather) and ``reset`` (a fill).
+    """
+
+    def __init__(self, *, num_layers, num_heads, head_dim, num_pages,
+                 page_size, max_seq_len, dtype=jnp.bfloat16):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = math.ceil(max_seq_len / page_size)
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        # LIFO free list: recently-freed (still-warm) pages are reused first
+        self._free = list(range(num_pages - 1, -1, -1))
+        self._tables = {}          # seq_id -> [physical page ids]
+
+    # ------------------------------------------------------------ queries
+    @property
+    def num_free_pages(self):
+        return len(self._free)
+
+    @property
+    def num_used_pages(self):
+        return self.num_pages - len(self._free)
+
+    def occupancy(self):
+        """Fraction of the pool in use, 0..1."""
+        return self.num_used_pages / self.num_pages
+
+    def pages_for(self, num_tokens):
+        return math.ceil(num_tokens / self.page_size)
+
+    def can_allocate(self, num_tokens):
+        return self.pages_for(num_tokens) <= len(self._free)
+
+    def seq_ids(self):
+        return list(self._tables)
+
+    # ------------------------------------------------------- alloc / free
+    def allocate(self, seq_id, num_tokens):
+        """Reserve pages for a new sequence of num_tokens.  Returns True
+        on success; False (allocating nothing) when the pool can't cover
+        the request — the engine's admission gate."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id!r} already allocated")
+        need = self.pages_for(num_tokens)
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"seq {seq_id!r}: {num_tokens} tokens need {need} pages > "
+                f"max_pages_per_seq {self.max_pages_per_seq}")
+        if need > len(self._free):
+            return False
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        return True
+
+    def extend(self, seq_id, num_tokens):
+        """Grow seq_id's table to cover num_tokens total.  True on
+        success; False (table unchanged) when the pool is exhausted —
+        the engine then preempts."""
+        table = self._tables[seq_id]
+        need = self.pages_for(num_tokens) - len(table)
+        if need <= 0:
+            return True
+        if len(table) + need > self.max_pages_per_seq:
+            raise ValueError(
+                f"seq {seq_id!r}: extend to {num_tokens} tokens exceeds "
+                f"max_pages_per_seq {self.max_pages_per_seq}")
+        if need > len(self._free):
+            return False
+        table.extend(self._free.pop() for _ in range(need))
+        return True
+
+    def free(self, seq_id):
+        """Return seq_id's pages to the pool (stale contents are fine:
+        pages are fully overwritten before they are ever read again)."""
+        for p in self._tables.pop(seq_id):
+            self._free.append(p)
+
+    def reset(self):
+        """Free everything and zero the pool."""
+        self._tables.clear()
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self.k_pages = jnp.zeros_like(self.k_pages)
+        self.v_pages = jnp.zeros_like(self.v_pages)
+
+    # ---------------------------------------------------------- page table
+    def page_table(self, seq_id, width=None):
+        """seq_id's table padded with 0 to ``width`` (default
+        max_pages_per_seq).  Pad entries are never read: attention masks
+        by seq_len and writes are index-routed out of bounds first."""
+        width = width or self.max_pages_per_seq
+        table = self._tables[seq_id]
+        return table + [0] * (width - len(table))
+
+    # -------------------------------------------------------------- defrag
+    def defrag(self):
+        """Compact live pages into the low-index prefix of the pool.
+
+        Long-running engines interleave alloc/free until the free list is
+        scattered; compaction restores locality (sequential page ids DMA
+        as one contiguous stream on TPU) and makes the pool's live set
+        checkpointable as a prefix slice.  One gather per pool array;
+        page tables are remapped in place.  Returns pages moved."""
+        order = []                   # new physical slot -> old page id
+        remap = {}                   # old page id -> new page id
+        for seq_id in self._tables:
+            for old in self._tables[seq_id]:
+                remap[old] = len(order)
+                order.append(old)
+        n_used = len(order)
+        moved = sum(1 for old, new in remap.items() if old != new)
+        if moved == 0:
+            return 0
+        order += [p for p in range(self.num_pages) if p not in remap]
+        idx = jnp.asarray(order, jnp.int32)
+        self.k_pages = jnp.take(self.k_pages, idx, axis=1)
+        self.v_pages = jnp.take(self.v_pages, idx, axis=1)
+        self._tables = {sid: [remap[p] for p in t]
+                        for sid, t in self._tables.items()}
+        self._free = list(range(self.num_pages - 1, n_used - 1, -1))
+        return moved

@@ -1,0 +1,144 @@
+"""Serving metrics — counters, gauges and latency histograms.
+
+The serving analog of the reference's inference benchmark counters
+(paddle/fluid/inference/api/details reported QPS/latency); here every
+engine step feeds a small registry the bench and operators read:
+
+  queue_wait   — submit -> admission (scheduler pressure)
+  ttft         — submit -> first token (prefill + queueing, the user-felt
+                 latency of a streaming response's first byte)
+  decode_token — per-token decode step time (steady-state speed)
+  page_occupancy — page-pool utilisation gauge, 0..1
+
+Histograms keep fixed log-spaced buckets (Prometheus-style) plus exact
+percentiles over a bounded reservoir.  Engine phases are additionally
+wrapped in profiler.RecordEvent, so a paddle_tpu.profiler.Profiler
+session captures serving activity in its host trace/summary with no
+extra wiring.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+
+__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics"]
+
+
+class Counter:
+    """Monotonic event counter."""
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge:
+    """Last-value gauge that also tracks its peak."""
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+        self.peak = 0.0
+
+    def set(self, v):
+        self.value = float(v)
+        self.peak = max(self.peak, self.value)
+
+
+class Histogram:
+    """Log-bucketed latency histogram with exact bounded-reservoir
+    percentiles (the reservoir keeps the newest ``reservoir`` samples —
+    serving metrics should reflect current behavior, not cold-start)."""
+
+    def __init__(self, name, start=1e-4, factor=2.0, count=20,
+                 reservoir=2048):
+        self.name = name
+        self.buckets = [start * factor ** i for i in range(count)]
+        self.counts = [0] * (count + 1)          # +1 for the overflow bucket
+        self.total = 0
+        self.sum = 0.0
+        self._reservoir = reservoir
+        self._samples = []
+
+    def observe(self, v):
+        v = float(v)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.total += 1
+        self.sum += v
+        self._samples.append(v)
+        if len(self._samples) > self._reservoir:
+            del self._samples[:len(self._samples) - self._reservoir]
+
+    @property
+    def mean(self):
+        return self.sum / self.total if self.total else 0.0
+
+    def percentile(self, p):
+        """Exact percentile over the reservoir (p in 0..100)."""
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, math.ceil(p / 100.0 * len(s)) - 1))
+        return s[idx]
+
+    def summary(self):
+        return {"count": self.total, "mean": self.mean,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99)}
+
+
+class ServingMetrics:
+    """The engine's metric registry; snapshot() is the bench/ops surface."""
+
+    def __init__(self):
+        self.requests_submitted = Counter("requests_submitted")
+        self.requests_admitted = Counter("requests_admitted")
+        self.requests_finished = Counter("requests_finished")
+        self.requests_rejected = Counter("requests_rejected")
+        self.requests_preempted = Counter("requests_preempted")
+        self.prefill_tokens = Counter("prefill_tokens")
+        self.tokens_generated = Counter("tokens_generated")
+        self.queue_wait = Histogram("queue_wait_s")
+        self.ttft = Histogram("ttft_s")
+        self.decode_token = Histogram("decode_token_s")
+        self.page_occupancy = Gauge("page_occupancy")
+
+    def snapshot(self):
+        return {
+            "requests": {
+                "submitted": self.requests_submitted.value,
+                "admitted": self.requests_admitted.value,
+                "finished": self.requests_finished.value,
+                "rejected": self.requests_rejected.value,
+                "preempted": self.requests_preempted.value,
+            },
+            "tokens": {
+                "prefill": self.prefill_tokens.value,
+                "generated": self.tokens_generated.value,
+            },
+            "queue_wait_s": self.queue_wait.summary(),
+            "ttft_s": self.ttft.summary(),
+            "decode_token_s": self.decode_token.summary(),
+            "page_occupancy": {"current": self.page_occupancy.value,
+                               "peak": self.page_occupancy.peak},
+        }
+
+    def summary(self):
+        """Human-readable one-screen summary (Profiler.summary style)."""
+        s = self.snapshot()
+        lines = [f"{'requests':<16} " + "  ".join(
+            f"{k}={v}" for k, v in s["requests"].items())]
+        lines.append(f"{'tokens':<16} prefill={s['tokens']['prefill']} "
+                     f"generated={s['tokens']['generated']}")
+        for key in ("queue_wait_s", "ttft_s", "decode_token_s"):
+            h = s[key]
+            lines.append(
+                f"{key:<16} n={h['count']:<6} mean={h['mean']*1e3:8.2f}ms "
+                f"p50={h['p50']*1e3:8.2f}ms p95={h['p95']*1e3:8.2f}ms")
+        occ = s["page_occupancy"]
+        lines.append(f"{'page_occupancy':<16} current={occ['current']:.2f} "
+                     f"peak={occ['peak']:.2f}")
+        return "\n".join(lines)

@@ -271,6 +271,13 @@ class Conll05(Dataset):
         if not cols:
             return
         n_frames = len(cols[0]) - 1              # col 0 = target verbs
+        for i, row in enumerate(cols):
+            if len(row) != len(cols[0]):
+                raise ValueError(
+                    f"Conll05: malformed props row for token {i} "
+                    f"({sent[i]!r}) in sentence starting {sent[0]!r}: "
+                    f"expected {len(cols[0])} columns (from the first "
+                    f"row), got {len(row)}")
         verbs = [row[0] for row in cols if row[0] != "-"]
         for f in range(n_frames):
             spans = [row[1 + f] for row in cols]

@@ -38,9 +38,12 @@ def _arr(x, dtype=jnp.float32):
 
 def _bilinear(feat, y, x):
     """Bilinear sample feat [C, H, W] at (y, x) grids [...]; out-of-range
-    samples contribute 0 (reference roi_align boundary handling)."""
+    samples contribute 0 (reference roi_align boundary handling).  Bounds
+    are inclusive at both ends (reference roi_align_op.cc zeroes only
+    y < -1 or y > height): a sample exactly at the image edge (y == H) is
+    clamped onto the last row and sampled, not dropped."""
     C, H, W = feat.shape
-    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    valid = (y >= -1.0) & (y <= H) & (x >= -1.0) & (x <= W)
     y = jnp.clip(y, 0.0, H - 1)
     x = jnp.clip(x, 0.0, W - 1)
     y0 = jnp.floor(y).astype(jnp.int32)

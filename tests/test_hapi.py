@@ -221,10 +221,19 @@ class TestDistributedFit:
                               verbose=0)
         assert "acc" in logs or any(k.startswith("acc") for k in logs)
 
-    def test_indivisible_batch_is_loud(self):
+    def test_indivisible_batch_trims_ragged_tail(self):
+        """A user-supplied batch not divisible by dp is trimmed to the
+        largest dp multiple (reference distributed-sampler drop
+        semantics) instead of raising mid-epoch; a batch smaller than dp
+        is padded by repeating the last sample."""
         model = Model(_net(9))
         opt = paddle.optimizer.SGD(learning_rate=0.1,
                                    parameters=model.parameters())
         model.prepare(opt, nn.CrossEntropyLoss(), device_mesh="auto")
-        with pytest.raises(ValueError, match="divide"):
-            model.fit(ToyDataset(n=12), batch_size=12, verbose=0)
+        hist = model.fit(ToyDataset(n=12), batch_size=12, verbose=0)
+        assert len(hist) == 1 and np.isfinite(hist[0]["loss"])
+        # smaller than dp: padded, still runs
+        x = np.random.rand(3, 8).astype(np.float32)
+        y = np.random.randint(0, 2, (3,))
+        loss, _ = model.train_batch(x, y)
+        assert np.isfinite(loss)

@@ -1,0 +1,337 @@
+"""Serving subsystem tests: paged KV cache, paged-attention decode,
+continuous batching, sampling determinism — plus regression tests for
+the roi_align edge-semantics and Conll05 parse-guard fixes that rode in
+the same PR."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.paged_attention import (_paged_attention_kernel,
+                                                _paged_attention_ref,
+                                                paged_attention,
+                                                paged_attention_available)
+from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_forward, gpt_init
+from paddle_tpu.serving import (Engine, PagedKVCache, RequestState,
+                                SamplingParams)
+
+
+def _tiny_cfg():
+    # fp32 everywhere: the greedy-parity tests compare argmax across two
+    # computation orders, so bf16 rounding noise is not welcome
+    return dataclasses.replace(GPT_CONFIGS["tiny"], dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny_cfg()
+    params = gpt_init(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def naive_generate(cfg, params, prompt, n_new):
+    """Full-recompute greedy decoding — the correctness oracle."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = gpt_forward(cfg, params, jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+# ------------------------------------------------------------- page pool
+
+
+class TestPagedKVCache:
+    def _cache(self, num_pages=8, page_size=4):
+        return PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                            num_pages=num_pages, page_size=page_size,
+                            max_seq_len=32)
+
+    def test_alloc_free_reuse(self):
+        c = self._cache()
+        assert c.allocate("a", 9)            # 3 pages
+        assert c.num_used_pages == 3
+        table = c.page_table("a")
+        assert len(table) == c.max_pages_per_seq
+        assert len(set(table[:3])) == 3
+        c.free("a")
+        assert c.num_free_pages == 8
+        # freed pages are reusable immediately
+        assert c.allocate("b", 32)           # all 8 pages
+        assert c.num_free_pages == 0
+        c.free("b")
+
+    def test_exhaustion_returns_false_without_partial_alloc(self):
+        c = self._cache()
+        assert c.allocate("a", 20)           # 5 of 8 pages
+        free_before = c.num_free_pages
+        assert not c.allocate("b", 16)       # needs 4, only 3 left
+        assert c.num_free_pages == free_before   # nothing leaked
+        assert c.extend("a", 32)             # grow to all 8
+        assert not c.extend("a", 33) if c.max_pages_per_seq > 8 else True
+
+    def test_occupancy_and_extend(self):
+        c = self._cache()
+        c.allocate("a", 4)
+        assert c.occupancy() == pytest.approx(1 / 8)
+        assert c.extend("a", 5)              # second page
+        assert c.occupancy() == pytest.approx(2 / 8)
+        assert c.extend("a", 5)              # idempotent: already covered
+        assert c.occupancy() == pytest.approx(2 / 8)
+
+    def test_defrag_compacts_and_preserves_contents(self):
+        c = self._cache()
+        c.allocate("a", 8)
+        c.allocate("b", 8)
+        c.allocate("c", 8)
+        # stamp each sequence's pages with a recognizable value
+        for sid, val in (("a", 1.0), ("b", 2.0), ("c", 3.0)):
+            for p in c.page_table(sid)[:2]:
+                c.k_pages = c.k_pages.at[:, p].set(val)
+        c.free("b")                          # hole in the middle
+        before = {sid: np.asarray(c.k_pages[0, c.page_table(sid)[:2]])
+                  for sid in ("a", "c")}
+        moved = c.defrag()
+        assert moved > 0
+        # live pages now occupy the low-index prefix
+        live = sorted(p for sid in ("a", "c") for p in c.page_table(sid)[:2])
+        assert live == list(range(4))
+        for sid in ("a", "c"):
+            after = np.asarray(c.k_pages[0, c.page_table(sid)[:2]])
+            np.testing.assert_array_equal(before[sid], after)
+        assert c.defrag() == 0               # already compact
+
+
+# ----------------------------------------------------- paged attention
+
+
+class TestPagedAttention:
+    def _case(self, dtype=jnp.float32):
+        B, H, hd, P, ps, M = 3, 4, 16, 12, 4, 4
+        ks = jax.random.split(jax.random.key(1), 3)
+        q = jax.random.normal(ks[0], (B, H, hd), dtype)
+        kp = jax.random.normal(ks[1], (P, ps, H, hd), dtype)
+        vp = jax.random.normal(ks[2], (P, ps, H, hd), dtype)
+        tables = jnp.asarray([[3, 1, 7, 2], [5, 8, 0, 0], [9, 0, 0, 0]],
+                             jnp.int32)
+        lens = jnp.asarray([14, 6, 0], jnp.int32)   # ragged + inactive
+        return q, kp, vp, tables, lens
+
+    def test_ref_matches_full_attention(self):
+        """The paged gather+mask must equal dense softmax attention over
+        each sequence's first seq_len tokens."""
+        q, kp, vp, tables, lens = self._case()
+        out = _paged_attention_ref(q, kp, vp, tables, lens,
+                                   1.0 / np.sqrt(q.shape[-1]))
+        ps = kp.shape[1]
+        for b in range(q.shape[0]):
+            n = int(lens[b])
+            if n == 0:
+                np.testing.assert_array_equal(np.asarray(out[b]), 0.0)
+                continue
+            k = jnp.concatenate([kp[p] for p in np.asarray(tables[b])],
+                                axis=0)[:n]          # [n, H, hd]
+            v = jnp.concatenate([vp[p] for p in np.asarray(tables[b])],
+                                axis=0)[:n]
+            s = jnp.einsum("hd,thd->ht", q[b].astype(jnp.float32),
+                           k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+            p_ = jax.nn.softmax(s, axis=-1)
+            ref = jnp.einsum("ht,thd->hd", p_, v.astype(jnp.float32))
+            np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.skipif(not paged_attention_available(),
+                        reason="pallas unavailable")
+    def test_kernel_matches_ref_interpret(self):
+        q, kp, vp, tables, lens = self._case()
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        ref = _paged_attention_ref(q, kp, vp, tables, lens, scale)
+        ker = _paged_attention_kernel(q, kp, vp, tables, lens, scale,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_public_entry_runs(self):
+        q, kp, vp, tables, lens = self._case()
+        out = paged_attention(q, kp, vp, tables, lens)
+        assert out.shape == q.shape and out.dtype == q.dtype
+
+
+# ------------------------------------------------- continuous batching
+
+
+class TestEngine:
+    def test_greedy_matches_full_recompute_ragged(self, tiny_model):
+        """Acceptance: ragged batch of 4 prompts, token-identical to the
+        full-recompute oracle, with max_batch_size 2 forcing two of the
+        requests to be admitted only after decoding has started."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(0, cfg.vocab_size, n))
+                   for n in (5, 11, 3, 17)]
+        refs = [naive_generate(cfg, params, p, 8) for p in prompts]
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, prefill_len=32)
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=8))
+        assert outs == refs
+        m = eng.metrics.snapshot()
+        assert m["requests"]["finished"] == 4
+        assert m["tokens"]["generated"] == 32
+        assert eng.cache.num_free_pages == eng.cache.num_pages  # all freed
+
+    def test_late_request_admitted_mid_decode(self, tiny_model):
+        """Explicit continuous-batching check: a request submitted after
+        several decode steps joins the in-flight batch, and neither it
+        nor the already-running sequences diverge from their
+        single-request outputs."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(7)
+        early = [list(rng.randint(0, cfg.vocab_size, n)) for n in (6, 9)]
+        late = list(rng.randint(0, cfg.vocab_size, 4))
+        sp = SamplingParams(max_new_tokens=10)
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=4, prefill_len=32)
+        reqs = [eng.add_request(p, sp) for p in early]
+        for _ in range(3):
+            eng.step()                        # decoding well underway
+        assert all(len(r.output) >= 3 for r in reqs)
+        late_req = eng.add_request(late, sp)
+        while eng.has_work():
+            eng.step()
+        # the late request was admitted while others were mid-decode and
+        # still matches its solo greedy output; so do the early ones
+        assert late_req.output == naive_generate(cfg, params, late, 10)
+        for r, p in zip(reqs, early):
+            assert r.output == naive_generate(cfg, params, p, 10)
+
+    def test_pool_exhaustion_rejects_gracefully(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=4,
+                     max_batch_size=2, prefill_len=32)   # 32-token pool
+        r = eng.add_request(list(range(20)),
+                            SamplingParams(max_new_tokens=20))
+        assert r.state == RequestState.REJECTED
+        assert "page pool exhausted" in r.finish_reason
+        assert eng.metrics.requests_rejected.value == 1
+        # a feasible request still runs fine afterwards
+        out = eng.generate([list(range(8))],
+                           SamplingParams(max_new_tokens=4))
+        assert len(out[0]) == 4
+
+    def test_preemption_recompute_is_lossless(self, tiny_model):
+        """Two sequences that overflow the pool mid-decode: the youngest
+        is preempted back to the queue, recomputed later, and its final
+        output equals its uninterrupted solo run."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(3)
+        p1 = list(rng.randint(0, cfg.vocab_size, 14))
+        p2 = list(rng.randint(0, cfg.vocab_size, 14))
+        eng = Engine(cfg, params, page_size=8, num_pages=6,
+                     max_batch_size=2, prefill_len=32)
+        sp = SamplingParams(max_new_tokens=20)
+        outs = eng.generate([p1, p2], sp)
+        assert eng.metrics.requests_preempted.value > 0
+        assert outs[0] == naive_generate(cfg, params, p1, 20)
+        assert outs[1] == naive_generate(cfg, params, p2, 20)
+
+    def test_sampling_deterministic_under_fixed_seed(self, tiny_model):
+        cfg, params = tiny_model
+        rng = np.random.RandomState(5)
+        prompts = [list(rng.randint(0, cfg.vocab_size, n)) for n in (6, 12)]
+        sp = SamplingParams(max_new_tokens=10, temperature=0.8, top_k=40,
+                            top_p=0.9, seed=1234)
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, prefill_len=32)
+        a = eng.generate(prompts, sp)
+        b = eng.generate(prompts, sp)
+        assert a == b
+        # a different seed diverges (vocab 1024, 10 steps: collision odds
+        # are negligible)
+        sp2 = dataclasses.replace(sp, seed=99)
+        c = eng.generate(prompts, sp2)
+        assert c != a
+
+    def test_stop_token_ends_generation(self, tiny_model):
+        cfg, params = tiny_model
+        prompt = list(range(4))
+        first = naive_generate(cfg, params, prompt, 1)[0]
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=1, prefill_len=32)
+        req = eng.add_request(prompt, SamplingParams(
+            max_new_tokens=10, stop_token_ids=(first,)))
+        while eng.has_work():
+            eng.step()
+        assert req.output == [first]
+        assert req.finish_reason == "stop"
+
+    def test_generation_predictor_api(self, tiny_model):
+        cfg, params = tiny_model
+        from paddle_tpu.inference import Config, create_predictor
+
+        config = Config().enable_generation(
+            cfg, params, page_size=8, num_pages=64, max_batch_size=2,
+            prefill_len=32)
+        pred = create_predictor(config)
+        prompt = list(range(6))
+        out = pred.generate([prompt], SamplingParams(max_new_tokens=5))
+        assert out[0] == naive_generate(cfg, params, prompt, 5)
+        snap = pred.metrics()
+        assert snap["requests"]["finished"] == 1
+        assert snap["ttft_s"]["count"] == 1
+
+
+# --------------------------------------------------- satellite regressions
+
+
+class TestRoiAlignEdge:
+    def test_sample_exactly_at_image_edge_is_clamped_not_dropped(self):
+        """A sampling point at exactly y == H (or x == W) must clamp onto
+        the edge pixel (reference roi_align_op.cc zeroes only beyond ±1
+        past the edge), not contribute zero."""
+        from paddle_tpu.vision.detection_ops import roi_align
+
+        feat = np.ones((1, 1, 4, 4), np.float32)
+        # aligned: box (3.5, 3.5)-(4.5, 4.5) - 0.5 => y1=x1=3, y2=x2=4;
+        # output 1x1, sampling_ratio 1 => single sample at (3.5+0.5)=4.0
+        boxes = np.asarray([[3.5, 3.5, 4.5, 4.5]], np.float32)
+        out = roi_align(feat, boxes, output_size=1, sampling_ratio=1,
+                        aligned=True)
+        assert float(np.asarray(out)[0, 0, 0, 0]) == pytest.approx(1.0)
+
+    def test_sample_beyond_edge_still_zero(self):
+        from paddle_tpu.vision.detection_ops import roi_align
+
+        feat = np.ones((1, 1, 4, 4), np.float32)
+        # sample lands at 5.5 > H + 1: stays invalid
+        boxes = np.asarray([[5.0, 5.0, 6.0, 6.0]], np.float32)
+        out = roi_align(feat, boxes, output_size=1, sampling_ratio=1,
+                        aligned=True)
+        assert float(np.asarray(out)[0, 0, 0, 0]) == 0.0
+
+
+class TestConll05Guard:
+    def _emit(self, sent, cols):
+        from paddle_tpu.text import Conll05
+
+        ds = object.__new__(Conll05)
+        ds.samples = []
+        ds.word_dict = ds.label_dict = None
+        ds._emit(sent, cols)
+        return ds.samples
+
+    def test_well_formed_rows_parse(self):
+        samples = self._emit(
+            ["the", "cat", "sat"],
+            [["-", "(A0*"], ["-", "*)"], ["sat", "(V*)"]])
+        assert len(samples) == 1
+        words, pred, labels = samples[0]
+        assert pred == "sat"
+        assert labels == ["B-A0", "I-A0", "B-V"]
+
+    def test_malformed_short_row_raises_descriptive_error(self):
+        with pytest.raises(ValueError, match="malformed props row"):
+            self._emit(["the", "cat", "sat"],
+                       [["-", "(A0*"], ["-"], ["sat", "(V*)"]])
