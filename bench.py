@@ -399,6 +399,35 @@ def bench_ps(rows=100_000, dim=64, batch=4096):
             s.stop()
 
 
+# ----------------------------------------------------- section telemetry
+
+
+def _section_telemetry(out):
+    """Attach the global observability snapshot to one section's JSON:
+    ``metrics`` is the default MetricsRegistry (serving counters, jit
+    compile counters, ...), ``jit`` the compile watchdog's per-function
+    report (compiles/recompiles/compile wall-time/cost analysis).  The
+    watchdog is enabled at section start by _enable_watchdog."""
+    if not isinstance(out, dict):
+        return out
+    from paddle_tpu.observability import default_registry, default_watchdog
+
+    out["metrics"] = default_registry().snapshot()
+    report = default_watchdog().report()
+    if report:
+        out["jit"] = report
+    return out
+
+
+def _enable_watchdog():
+    """Every bench section runs with the compile watchdog on: any
+    recompile during a steady-state window is a perf bug, and the
+    WARNING lands in the section's stderr next to the measurements."""
+    from paddle_tpu.observability import enable_compile_watchdog
+
+    enable_compile_watchdog()
+
+
 # -------------------------------------------------- subprocess plumbing
 
 
@@ -530,33 +559,36 @@ def main():
     args = ap.parse_args()
 
     # ---- section mode: one measurement, one JSON line ----
+    if args.section:
+        _enable_watchdog()
     if args.section == "gpt":
         # no in-process fallback: a failed attempt can poison the process
         # (r4 cascade) — the orchestrator retries gpt2-small in a FRESH
         # subprocess via --gpt-config
         out = bench_gpt(args.gpt_config, args.steps, args.warmup,
                         args.batch, args.seq, accum=args.accum)
-        print(json.dumps(out))
+        print(json.dumps(_section_telemetry(out)))
         return
     if args.section == "rung":
         name, kw = LADDER_13B[args.rung]
-        print(json.dumps(bench_gpt(
-            name, max(args.steps // 2, 5), args.warmup, **kw)))
+        print(json.dumps(_section_telemetry(bench_gpt(
+            name, max(args.steps // 2, 5), args.warmup, **kw))))
         return
     if args.section == "flash":
         out = bench_flash_vs_xla()
         # None = flash kernel not available on this backend: a clean
         # skip, not a failure
-        print(json.dumps(out if out is not None else {"skipped": True}))
+        print(json.dumps(_section_telemetry(out)
+                         if out is not None else {"skipped": True}))
         return
     if args.section == "resnet":
-        print(json.dumps(bench_resnet()))
+        print(json.dumps(_section_telemetry(bench_resnet())))
         return
     if args.section == "ps":
-        print(json.dumps(bench_ps()))
+        print(json.dumps(_section_telemetry(bench_ps())))
         return
     if args.section == "serving":
-        print(json.dumps(bench_serving()))
+        print(json.dumps(_section_telemetry(bench_serving())))
         return
 
     # ---- orchestrator: every section in its own subprocess ----

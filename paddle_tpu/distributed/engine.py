@@ -1300,7 +1300,13 @@ class HybridEngine:
             out_specs=(specs, opt_specs, P()),
             check_vma=True,
         )
-        self._step_fn = jax.jit(mapped, donate_argnums=(0, 1))
+        # watchdog-wrapped: the hybrid step is the training hot loop —
+        # one config compiles once; a recompile means a tokens/labels
+        # shape or dtype drifted and the watchdog names the culprit
+        from ..observability.compile_watchdog import watch
+
+        self._step_fn = watch(jax.jit(mapped, donate_argnums=(0, 1)),
+                              name="hybrid_engine::step")
         return self._step_fn
 
     def step(self, params, opt_state, tokens, labels, lr=None,

@@ -1,8 +1,8 @@
 """hapi — the high-level Model.fit API (parity: python/paddle/hapi/)."""
 from . import callbacks
 from .callbacks import (Callback, EarlyStopping, LRScheduler,
-                        ModelCheckpoint, ProgBarLogger)
+                        ModelCheckpoint, ProfilerCallback, ProgBarLogger)
 from .model import Model
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "callbacks"]
+           "EarlyStopping", "LRScheduler", "ProfilerCallback", "callbacks"]

@@ -9,7 +9,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "CallbackList"]
+           "LRScheduler", "ProfilerCallback", "CallbackList"]
 
 
 class Callback:
@@ -157,6 +157,52 @@ class EarlyStopping(Callback):
             if self.wait >= self.patience:
                 self.stopped_epoch = epoch
                 self.model.stop_training = True
+
+
+class ProfilerCallback(Callback):
+    """Step-aware profiling of Model.fit (reference: profiler examples
+    drive ``prof.step()`` from the train loop; here the callback owns
+    that wiring).
+
+    Each train batch runs inside a ``hapi::train_batch`` RecordEvent
+    span and ends with ``profiler.step()``, so the scheduler window
+    machine advances per batch and every recorded step carries its
+    boundary instant + metric counter events.
+
+    Pass a configured :class:`paddle_tpu.profiler.Profiler`, or
+    scheduler args to build one: ``ProfilerCallback(scheduler=(wait,
+    warmup, active, repeat), on_trace_ready=export_chrome_tracing(dir))``.
+    """
+
+    def __init__(self, profiler=None, scheduler=None, on_trace_ready=None,
+                 with_device=False):
+        super().__init__()
+        if profiler is None:
+            from ..profiler import Profiler
+
+            profiler = Profiler(scheduler=scheduler,
+                                on_trace_ready=on_trace_ready,
+                                with_device=with_device)
+        self.profiler = profiler
+        self._batch_event = None
+
+    def on_train_begin(self, logs=None):
+        self.profiler.start()
+
+    def on_train_batch_begin(self, step, logs=None):
+        from ..profiler import RecordEvent
+
+        self._batch_event = RecordEvent("hapi::train_batch")
+        self._batch_event.begin()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._batch_event is not None:
+            self._batch_event.end()
+            self._batch_event = None
+        self.profiler.step()
+
+    def on_train_end(self, logs=None):
+        self.profiler.stop()
 
 
 class LRScheduler(Callback):

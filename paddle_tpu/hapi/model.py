@@ -20,6 +20,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
+from ..observability.compile_watchdog import watch
+from ..profiler.profiler import RecordEvent
 from .callbacks import CallbackList, ProgBarLogger
 
 __all__ = ["Model"]
@@ -97,7 +99,7 @@ class Model:
                 params, grads, opt_state, lr)
             return new_params, new_opt, loss, out, new_buffers
 
-        self._jit_step = jax.jit(step)
+        self._jit_step = watch(jax.jit(step), name="hapi::train_step")
         return self._jit_step
 
     def _shard_batch(self, x, y):
@@ -142,8 +144,9 @@ class Model:
                 self._opt_state = opt.init_state(params)
             step = self._build_jit_step()
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            new_params, self._opt_state, loss, out, new_buffers = step(
-                params, buffers, self._opt_state, x, y, lr)
+            with RecordEvent("hapi::train_step"):
+                new_params, self._opt_state, loss, out, new_buffers = step(
+                    params, buffers, self._opt_state, x, y, lr)
             named = dict(self.network.named_parameters())
             for k, v in new_params.items():
                 named[k].data = v
@@ -181,8 +184,9 @@ class Model:
                      jnp.zeros(())) if loss is not None else jnp.zeros(())
                 return l, out_arr
 
-            self._jit_eval = jax.jit(ev)
-        loss, out = self._jit_eval(params, buffers, x, y)
+            self._jit_eval = watch(jax.jit(ev), name="hapi::eval_step")
+        with RecordEvent("hapi::eval_step"):
+            loss, out = self._jit_eval(params, buffers, x, y)
         results = self._update_metrics(out, y)
         return float(loss), results
 

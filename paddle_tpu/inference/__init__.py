@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..observability.compile_watchdog import watch
 
 __all__ = ["Config", "PrecisionType", "create_predictor", "Predictor",
            "GenerationPredictor"]
@@ -207,7 +208,8 @@ class Predictor:
                 lambda t: t.data if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
 
-        jfn = jax.jit(pure)
+        jfn = watch(jax.jit(pure),
+                    name=f"inference::predictor[{precision}]")
         return lambda *arrs: jfn(params, buffers, *arrs)
 
     def _build_int8(self, layer):
@@ -269,7 +271,7 @@ class Predictor:
                 lambda t: t.data if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
 
-        jfn = jax.jit(pure)
+        jfn = watch(jax.jit(pure), name="inference::predictor[int8]")
         return lambda *arrs: jfn(params, buffers, *arrs)
 
     # ---- serving entry ------------------------------------------------

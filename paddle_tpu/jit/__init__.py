@@ -19,6 +19,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+from ..observability.compile_watchdog import watch
 
 __all__ = ["to_static", "TracedLayer", "save", "load", "not_to_static"]
 
@@ -48,7 +49,9 @@ class TracedLayer:
                     is_leaf=lambda t: isinstance(t, Tensor))
 
             self._pure = pure
-            self._compiled = jax.jit(pure)
+            self._compiled = watch(
+                jax.jit(pure),
+                name=f"jit::{type(layer).__name__}")
         else:
             fn = layer_or_fn
 
@@ -63,7 +66,9 @@ class TracedLayer:
                     is_leaf=lambda t: isinstance(t, Tensor))
 
             self._pure = pure
-            self._compiled = jax.jit(pure)
+            self._compiled = watch(
+                jax.jit(pure),
+                name=f"jit::{getattr(fn, '__name__', 'fn')}")
 
     def _unwrap(self, args):
         return tuple(a.data if isinstance(a, Tensor) else a for a in args)
@@ -143,7 +148,9 @@ def save(layer, path, input_spec=None, example_inputs=None):
         arr_args = traced._unwrap(tuple(example_inputs))
         # export for BOTH platforms so a TPU-saved artifact serves on CPU
         # hosts (and vice versa) — the cross-platform predictor scenario
-        exp = jax.export.export(traced._compiled, platforms=["cpu", "tpu"])
+        # (jax.export needs the raw PjitFunction, not the watchdog proxy)
+        jfn = getattr(traced._compiled, "__wrapped__", traced._compiled)
+        exp = jax.export.export(jfn, platforms=["cpu", "tpu"])
         if traced.is_layer:
             exported = exp(params, buffers, *arr_args)
         else:

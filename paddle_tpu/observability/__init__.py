@@ -1,0 +1,60 @@
+"""paddle_tpu.observability — the framework-wide telemetry layer.
+
+Three legs, one surface (reference: platform/profiler/ +
+platform/monitor.h grown into a production observability stack):
+
+- :mod:`.metrics` — thread-safe Counter/Gauge/Histogram with label
+  support, a process-wide default :class:`MetricsRegistry`, JSON
+  ``snapshot()`` and Prometheus text exposition.  ``serving.metrics``
+  is a thin client; bench embeds the snapshot in every section's JSON.
+- :mod:`.compile_watchdog` — opt-in wrapper around the repo's
+  ``jax.jit`` entry points (hapi train step, serving prefill/decode,
+  hybrid-engine step, inference predictors, jit.to_static): counts
+  compilations, records compile wall-time + HLO cost analysis, and
+  WARNs with the argument shape/dtype diff on post-warmup recompiles —
+  the ragged-shape regression detector.
+- the step-aware :class:`~paddle_tpu.profiler.Profiler` (re-exported
+  here lazily to avoid an import cycle): ``make_scheduler`` windows,
+  step-boundary instant events, and registry gauges emitted as
+  chrome-trace counter events into one Perfetto timeline.
+"""
+from __future__ import annotations
+
+from .compile_watchdog import (  # noqa: F401
+    CompileWatchdog,
+    default_watchdog,
+    disable_compile_watchdog,
+    enable_compile_watchdog,
+    watch,
+    watchdog_enabled,
+)
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
+    "CompileWatchdog", "default_watchdog", "watch",
+    "enable_compile_watchdog", "disable_compile_watchdog",
+    "watchdog_enabled",
+    # lazy (profiler leg)
+    "Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
+    "export_chrome_tracing",
+]
+
+_PROFILER_NAMES = {"Profiler", "RecordEvent", "ProfilerState",
+                   "make_scheduler", "export_chrome_tracing"}
+
+
+def __getattr__(name):
+    # profiler imports observability.metrics; re-export its surface
+    # lazily so the two packages don't import-cycle at module load
+    if name in _PROFILER_NAMES:
+        from .. import profiler
+
+        return getattr(profiler.profiler, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

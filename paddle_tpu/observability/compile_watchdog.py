@@ -1,0 +1,289 @@
+"""JIT compile watchdog — the ragged-shape regression detector.
+
+Unintended XLA recompilation is the silent TPU throughput killer: one
+ragged batch (a tail batch, an un-padded prompt, a dtype drift) and a
+"compiles once" step quietly compiles every call.  The watchdog wraps
+the repo's ``jax.jit`` entry points (hapi ``_build_jit_step``, the
+inference predictors, the serving engine's prefill/decode, the hybrid
+engine's train step, jit.to_static) and
+
+- counts compilations and calls per function (labelled counters
+  ``jit_compiles_total{fn=...}`` / ``jit_recompiles_total{fn=...}`` in
+  the default :class:`~paddle_tpu.observability.metrics.MetricsRegistry`),
+- records compile wall-time per function and, when the backend exposes
+  it, HLO cost analysis (flops / bytes accessed) for the compiled
+  program,
+- logs a WARNING with the per-argument shape/dtype **diff** whenever a
+  function recompiles after warmup (the first compile of a function is
+  warmup and logs nothing; repeated same-signature calls log nothing).
+
+Opt-in: wrapping is always installed but dormant — a disabled watchdog
+adds one attribute check per call.  Enable per process with
+:func:`enable_compile_watchdog` (or ``PADDLE_TPU_COMPILE_WATCHDOG=1`` in
+the environment), scoped with ``with watchdog_enabled(): ...``.
+
+A *compilation* is detected as a first-seen argument signature (the
+pytree of shapes/dtypes + static values) — exactly jax.jit's executable
+cache key, so the count matches XLA's behavior without reaching into
+jax internals.  Compile wall-time is the first call's wall time (trace +
+compile + run; on real programs run time is noise next to compile time).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+
+__all__ = ["CompileWatchdog", "watch", "default_watchdog",
+           "enable_compile_watchdog", "disable_compile_watchdog",
+           "watchdog_enabled"]
+
+logger = logging.getLogger("paddle_tpu.observability")
+
+
+def _aval_str(leaf):
+    """f32[8,128]-style rendering of one signature leaf."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return repr(leaf)
+    short = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+             "float16": "f16", "int32": "i32", "int64": "i64",
+             "int8": "i8", "uint32": "u32", "bool": "pred"}
+    dt = short.get(str(dtype), str(dtype))
+    return f"{dt}[{','.join(str(d) for d in shape)}]"
+
+
+def _signature(args, kwargs):
+    """((path, aval-string), ...) over the flattened call operands — the
+    jit cache key rendered human-readably, so the stored signature IS the
+    diffable artifact."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path((args, kwargs))[0]
+    return tuple((jax.tree_util.keystr(path), _aval_str(leaf))
+                 for path, leaf in flat)
+
+
+def _sig_diff(old, new):
+    """Human-readable per-argument diff between two signatures."""
+    old_d, new_d = dict(old), dict(new)
+    lines = []
+    for path, aval in new_d.items():
+        prev = old_d.get(path)
+        if prev is None:
+            lines.append(f"  {path}: (new) {aval}")
+        elif prev != aval:
+            lines.append(f"  {path}: {prev} -> {aval}")
+    for path, aval in old_d.items():
+        if path not in new_d:
+            lines.append(f"  {path}: {aval} -> (gone)")
+    if not lines:
+        lines.append("  (argument structure changed)")
+    return "\n".join(lines)
+
+
+def _cost_analysis(fn, args, kwargs, allow_compile=False):
+    """flops/bytes from XLA's cost analysis when the backend exposes it;
+    None otherwise.  Reads the Lowered stage (a retrace, no second
+    compile); the ``lowered.compile()`` fallback is gated behind
+    ``allow_compile`` because a second compile of a big program can cost
+    minutes.  Never raises."""
+    try:
+        lowered = fn.lower(*args, **kwargs)
+    except Exception:
+        return None
+    getters = [lambda: lowered.cost_analysis()]
+    if allow_compile:
+        getters.append(lambda: lowered.compile().cost_analysis())
+    for get in getters:
+        try:
+            ca = get()
+        except Exception:
+            continue
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            continue
+        out = {}
+        if "flops" in ca:
+            out["flops"] = float(ca["flops"])
+        for key in ("bytes accessed", "bytes_accessed"):
+            if key in ca:
+                out["bytes_accessed"] = float(ca[key])
+        if out:
+            return out
+    return None
+
+
+class _FnStats:
+    __slots__ = ("name", "calls", "compiles", "recompiles",
+                 "compile_time_s", "signatures", "last_signature",
+                 "cost")
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self.compiles = 0
+        self.recompiles = 0
+        self.compile_time_s = 0.0
+        self.signatures = set()
+        self.last_signature = None
+        self.cost = None
+
+    def as_dict(self):
+        d = {"calls": self.calls, "compiles": self.compiles,
+             "recompiles": self.recompiles,
+             "compile_time_s": self.compile_time_s}
+        if self.cost:
+            d["cost_analysis"] = dict(self.cost)
+        return d
+
+
+class WatchedFunction:
+    """Callable proxy over a jitted function.  Transparent to jax AOT
+    introspection: unknown attributes (``lower``, ``trace``, ...) forward
+    to the wrapped function, and ``__wrapped__`` exposes it for callers
+    that need the raw PjitFunction (e.g. ``jax.export.export``)."""
+
+    def __init__(self, fn, name, watchdog):
+        self.__wrapped__ = fn
+        self._name = name
+        self._watchdog = watchdog
+
+    def __call__(self, *args, **kwargs):
+        wd = self._watchdog
+        if not wd.enabled:
+            return self.__wrapped__(*args, **kwargs)
+        return wd._record_call(self, args, kwargs)
+
+    def __getattr__(self, attr):
+        return getattr(self.__wrapped__, attr)
+
+
+class CompileWatchdog:
+    """Per-process compile telemetry over any number of watched
+    functions.  ``report()`` returns {fn_name: {calls, compiles,
+    recompiles, compile_time_s, cost_analysis?}}."""
+
+    def __init__(self, registry=None, cost_analysis=True):
+        # cost_analysis: False = skip, True = Lowered-stage only,
+        # "full" = also allow a lowered.compile() fallback (a second
+        # compile — only sane for small programs)
+        self.enabled = os.environ.get(
+            "PADDLE_TPU_COMPILE_WATCHDOG", "") not in ("", "0", "false")
+        self.cost_analysis = cost_analysis
+        self._registry = registry
+        self._stats = {}
+        self._lock = threading.Lock()
+
+    # ---- lifecycle ------------------------------------------------------
+    def enable(self):
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def registry(self):
+        if self._registry is None:
+            from .metrics import default_registry
+
+            self._registry = default_registry()
+        return self._registry
+
+    # ---- wrapping -------------------------------------------------------
+    def watch(self, fn, name=None):
+        """Wrap a jitted callable; returns a transparent proxy."""
+        if isinstance(fn, WatchedFunction):
+            return fn
+        name = name or getattr(fn, "__name__", repr(fn))
+        return WatchedFunction(fn, name, self)
+
+    def _record_call(self, watched, args, kwargs):
+        sig = _signature(args, kwargs)
+        with self._lock:
+            st = self._stats.setdefault(
+                watched._name, _FnStats(watched._name))
+            st.calls += 1
+            is_new = sig not in st.signatures
+            prev_sig = st.last_signature
+            n_prior = len(st.signatures)
+            if is_new:
+                st.signatures.add(sig)
+            st.last_signature = sig
+        if not is_new:
+            return watched.__wrapped__(*args, **kwargs)
+
+        t0 = time.perf_counter()
+        out = watched.__wrapped__(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        cost = (_cost_analysis(watched.__wrapped__, args, kwargs,
+                               allow_compile=self.cost_analysis == "full")
+                if self.cost_analysis else None)
+        reg = self.registry()
+        reg.counter("jit_compiles_total",
+                    "XLA compilations per watched function",
+                    labelnames=("fn",)).labels(fn=watched._name).inc()
+        with self._lock:
+            st.compiles += 1
+            st.compile_time_s += dt
+            if cost:
+                st.cost = cost
+        if n_prior > 0:                       # recompile after warmup
+            with self._lock:
+                st.recompiles += 1
+            reg.counter("jit_recompiles_total",
+                        "post-warmup XLA recompilations (shape/dtype "
+                        "drift)", labelnames=("fn",)) \
+                .labels(fn=watched._name).inc()
+            logger.warning(
+                "recompilation #%d of %s (%.2fs): argument "
+                "signature changed\n%s",
+                n_prior, watched._name, dt, _sig_diff(prev_sig, sig))
+        else:
+            logger.debug("first compile of %s: %.2fs", watched._name, dt)
+        return out
+
+    # ---- reporting ------------------------------------------------------
+    def report(self):
+        with self._lock:
+            return {name: st.as_dict() for name, st in self._stats.items()}
+
+
+_default = CompileWatchdog()
+
+
+def default_watchdog() -> CompileWatchdog:
+    return _default
+
+
+def watch(fn, name=None):
+    """Wrap ``fn`` under the default watchdog (dormant until enabled)."""
+    return _default.watch(fn, name)
+
+
+def enable_compile_watchdog():
+    return _default.enable()
+
+
+def disable_compile_watchdog():
+    return _default.disable()
+
+
+@contextlib.contextmanager
+def watchdog_enabled(watchdog=None):
+    wd = watchdog or _default
+    prev = wd.enabled
+    wd.enable()
+    try:
+        yield wd
+    finally:
+        wd.enabled = prev

@@ -2,7 +2,15 @@
 
 Host-side RecordEvent tracing with chrome-trace export, composed with jax's
 device profiler (which captures XLA/TPU activity the way CUPTI captures
-kernels for the reference).
+kernels for the reference).  Step-aware scheduling (``make_scheduler``)
+and metric counter tracks come from the paddle_tpu.observability layer.
 """
-from .profiler import Profiler, RecordEvent, export_chrome_tracing  # noqa: F401
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    export_chrome_tracing,
+    make_scheduler,
+)
 from .timer import Benchmark  # noqa: F401

@@ -31,6 +31,7 @@ class Benchmark:
         self._step = 0
         self._reader_start = None
         self._batch_start = None
+        self._pending_reader_cost = 0.0
         self._info = _StepInfo()
 
     def before_reader(self):
@@ -39,9 +40,11 @@ class Benchmark:
     def after_reader(self):
         if self._reader_start is None:
             return
-        cost = time.perf_counter() - self._reader_start
-        if self._step >= self.warmup_steps:
-            self._info.reader_cost += cost
+        # stash; step_end commits reader + batch cost under ONE warmup
+        # test, so no call-order/convention skew can make a boundary step
+        # contribute reader cost but not batch cost (or vice versa)
+        self._pending_reader_cost += time.perf_counter() - self._reader_start
+        self._reader_start = None
 
     def step_start(self):
         self._batch_start = time.perf_counter()
@@ -50,8 +53,11 @@ class Benchmark:
         if self._batch_start is None:
             return
         cost = time.perf_counter() - self._batch_start
+        reader_cost, self._pending_reader_cost = \
+            self._pending_reader_cost, 0.0
         self._step += 1
         if self._step > self.warmup_steps:
+            self._info.reader_cost += reader_cost
             self._info.batch_cost += cost
             self._info.samples += num_samples
             self._info.steps += 1

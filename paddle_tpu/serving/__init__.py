@@ -3,8 +3,9 @@
 The production tail of the inference stack (the reference grew
 paddle/fluid/inference the same way): a paged KV cache
 (:mod:`kv_cache`), a continuous-batching scheduler (:mod:`engine`) over
-the paged-attention decode kernel (kernels/paged_attention.py), and a
-serving metrics registry (:mod:`metrics`).  ``inference.Config
+the paged-attention decode kernel (kernels/paged_attention.py), and the
+serving facade over the framework-wide metrics registry
+(:mod:`metrics` → paddle_tpu.observability).  ``inference.Config
 .enable_generation()`` + ``create_predictor`` expose it through the
 predictor API; ``bench.py --section serving`` measures tokens/sec and
 TTFT under a Poisson arrival trace.

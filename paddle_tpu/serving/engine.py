@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.gpt import GPTConfig, gpt_decode_step, gpt_init, gpt_prefill
+from ..observability.compile_watchdog import watch
 from ..profiler.profiler import RecordEvent
 from .kv_cache import PagedKVCache
 from .metrics import ServingMetrics
@@ -136,8 +137,13 @@ class Engine:
             return gpt_decode_step(cfg_, params, tokens, positions,
                                    seq_lens, k_pages, v_pages, tables)
 
-        self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
-        self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+        # watchdog-wrapped: both programs are statically shaped and must
+        # compile exactly once — any recompile here is a serving bug the
+        # watchdog flags with the offending shape diff
+        self._prefill_fn = watch(jax.jit(_prefill, donate_argnums=donate),
+                                 name="serving::prefill")
+        self._decode_fn = watch(jax.jit(_decode, donate_argnums=donate),
+                                name="serving::decode")
 
     # ------------------------------------------------------------- submit
     def add_request(self, prompt, sampling: SamplingParams = None):

@@ -1,8 +1,8 @@
-"""Serving metrics — counters, gauges and latency histograms.
+"""Serving metrics — a thin client of paddle_tpu.observability.
 
-The serving analog of the reference's inference benchmark counters
-(paddle/fluid/inference/api/details reported QPS/latency); here every
-engine step feeds a small registry the bench and operators read:
+The Counter/Gauge/Histogram primitives were promoted to
+:mod:`paddle_tpu.observability.metrics` (thread-safe, labelled,
+process-wide registry); this module keeps the serving-shaped facade:
 
   queue_wait   — submit -> admission (scheduler pressure)
   ttft         — submit -> first token (prefill + queueing, the user-felt
@@ -10,101 +10,51 @@ engine step feeds a small registry the bench and operators read:
   decode_token — per-token decode step time (steady-state speed)
   page_occupancy — page-pool utilisation gauge, 0..1
 
-Histograms keep fixed log-spaced buckets (Prometheus-style) plus exact
-percentiles over a bounded reservoir.  Engine phases are additionally
-wrapped in profiler.RecordEvent, so a paddle_tpu.profiler.Profiler
-session captures serving activity in its host trace/summary with no
-extra wiring.
+Every metric is registered (serving_-prefixed) into the default
+MetricsRegistry with replace semantics, so rebuilding ``ServingMetrics``
+(the bench's reset idiom) swaps fresh series into the global snapshot —
+and ``bench.py`` / Prometheus exposition / the profiler's counter events
+all see serving telemetry with no extra wiring.  Engine phases are
+additionally wrapped in profiler.RecordEvent, so a
+paddle_tpu.profiler.Profiler session captures serving activity in its
+host trace/summary.
 """
 from __future__ import annotations
 
-import bisect
-import math
+from ..observability.metrics import (  # noqa: F401  (re-export compat)
+    Counter,
+    Gauge,
+    Histogram,
+    default_registry,
+)
 
 __all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics"]
 
 
-class Counter:
-    """Monotonic event counter."""
-
-    def __init__(self, name):
-        self.name = name
-        self.value = 0
-
-    def inc(self, n=1):
-        self.value += n
-
-
-class Gauge:
-    """Last-value gauge that also tracks its peak."""
-
-    def __init__(self, name):
-        self.name = name
-        self.value = 0.0
-        self.peak = 0.0
-
-    def set(self, v):
-        self.value = float(v)
-        self.peak = max(self.peak, self.value)
-
-
-class Histogram:
-    """Log-bucketed latency histogram with exact bounded-reservoir
-    percentiles (the reservoir keeps the newest ``reservoir`` samples —
-    serving metrics should reflect current behavior, not cold-start)."""
-
-    def __init__(self, name, start=1e-4, factor=2.0, count=20,
-                 reservoir=2048):
-        self.name = name
-        self.buckets = [start * factor ** i for i in range(count)]
-        self.counts = [0] * (count + 1)          # +1 for the overflow bucket
-        self.total = 0
-        self.sum = 0.0
-        self._reservoir = reservoir
-        self._samples = []
-
-    def observe(self, v):
-        v = float(v)
-        self.counts[bisect.bisect_left(self.buckets, v)] += 1
-        self.total += 1
-        self.sum += v
-        self._samples.append(v)
-        if len(self._samples) > self._reservoir:
-            del self._samples[:len(self._samples) - self._reservoir]
-
-    @property
-    def mean(self):
-        return self.sum / self.total if self.total else 0.0
-
-    def percentile(self, p):
-        """Exact percentile over the reservoir (p in 0..100)."""
-        if not self._samples:
-            return 0.0
-        s = sorted(self._samples)
-        idx = min(len(s) - 1, max(0, math.ceil(p / 100.0 * len(s)) - 1))
-        return s[idx]
-
-    def summary(self):
-        return {"count": self.total, "mean": self.mean,
-                "p50": self.percentile(50), "p95": self.percentile(95),
-                "p99": self.percentile(99)}
-
-
 class ServingMetrics:
-    """The engine's metric registry; snapshot() is the bench/ops surface."""
+    """The engine's metric facade; snapshot() is the bench/ops surface.
 
-    def __init__(self):
-        self.requests_submitted = Counter("requests_submitted")
-        self.requests_admitted = Counter("requests_admitted")
-        self.requests_finished = Counter("requests_finished")
-        self.requests_rejected = Counter("requests_rejected")
-        self.requests_preempted = Counter("requests_preempted")
-        self.prefill_tokens = Counter("prefill_tokens")
-        self.tokens_generated = Counter("tokens_generated")
-        self.queue_wait = Histogram("queue_wait_s")
-        self.ttft = Histogram("ttft_s")
-        self.decode_token = Histogram("decode_token_s")
-        self.page_occupancy = Gauge("page_occupancy")
+    ``registry=None`` publishes into the process-wide default registry
+    (pass an explicit MetricsRegistry to isolate, e.g. in tests)."""
+
+    def __init__(self, registry=None):
+        self.registry = default_registry() if registry is None else registry
+        reg = self.registry
+
+        def add(metric):
+            return reg.register(metric, replace=True)
+
+        self.requests_submitted = add(Counter("serving_requests_submitted"))
+        self.requests_admitted = add(Counter("serving_requests_admitted"))
+        self.requests_finished = add(Counter("serving_requests_finished"))
+        self.requests_rejected = add(Counter("serving_requests_rejected"))
+        self.requests_preempted = add(Counter("serving_requests_preempted"))
+        self.prefill_tokens = add(Counter("serving_prefill_tokens"))
+        self.tokens_generated = add(Counter("serving_tokens_generated"))
+        self.queue_wait = add(Histogram("serving_queue_wait_s"))
+        self.ttft = add(Histogram("serving_ttft_s"))
+        self.decode_token = add(Histogram("serving_decode_token_s"))
+        self.page_occupancy = add(Gauge("serving_page_occupancy"))
 
     def snapshot(self):
         return {
