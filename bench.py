@@ -311,7 +311,10 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
     params = gpt_init(cfg, jax.random.key(0))
     eng = Engine(cfg, params, page_size=16,
                  num_pages=2048 if on_tpu else 512, max_batch_size=8,
-                 prefill_len=min(128, cfg.max_seq_len))
+                 prefill_len=min(128, cfg.max_seq_len),
+                 # production posture: shed at 95% pool / deep queue
+                 # rather than letting TTFT collapse for everyone
+                 shed_occupancy_high=0.95, shed_queue_high=4 * n_requests)
     rng = np.random.RandomState(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, n_requests))
     max_prompt = min(64, cfg.max_seq_len - max_new)
@@ -350,10 +353,15 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
         "page_occupancy_peak": snap["page_occupancy"]["peak"],
         "preempted": snap["requests"]["preempted"],
         "finished": snap["requests"]["finished"],
+        "shed": snap["requests"]["shed"],
+        "deadline_evicted": snap["requests"]["deadline_evicted"],
+        "engine_healthy": snap["engine_healthy"],
     }
     log(f"[serving] {out['tokens_per_sec']:.1f} tok/s, TTFT p50 "
         f"{out['ttft_ms_p50']:.0f}ms p95 {out['ttft_ms_p95']:.0f}ms, "
-        f"pool peak {out['page_occupancy_peak']*100:.0f}%")
+        f"pool peak {out['page_occupancy_peak']*100:.0f}%, "
+        f"shed {out['shed']}, deadline-evicted {out['deadline_evicted']}, "
+        f"{'healthy' if out['engine_healthy'] else 'degraded'}")
     return out
 
 

@@ -128,6 +128,7 @@ tensor = _tensor_ctor
 from .profiler.timer import Benchmark  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
 
 # distributed is imported lazily (it builds meshes); expose the module path
 from . import distributed  # noqa: F401,E402

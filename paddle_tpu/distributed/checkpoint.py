@@ -17,6 +17,15 @@ opt_from_canonical — one shard_map program each way).
 Layout on disk:
   <path>/manifest.json             tree structure, specs, mesh, step
   <path>/<leaf-id>/shard<k>.npy    one file per saved device shard
+
+Crash safety: every shard is written through the resilience layer's
+atomic tmp+rename helper with a running CRC32 recorded in its manifest
+entry, and the manifest itself is written LAST (atomically) — so a
+manifest's presence implies every shard it names was fully on disk
+first.  ``resilience.CheckpointManager`` adds the directory-level
+commit (step dir rename), retention, and checksum-verified restore
+with fallback; the named fault sites below are what its
+crash-consistency tests kill the process at.
 """
 from __future__ import annotations
 
@@ -27,6 +36,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..resilience.atomic import atomic_write
+from ..resilience.faults import fault_point
 
 __all__ = ["save_sharded", "load_sharded", "save_engine_state",
            "load_engine_state"]
@@ -75,6 +87,16 @@ def save_sharded(path, tree, step=None, extra=None):
     tag = f"r{rank}"
     os.makedirs(path, exist_ok=True)
     flat, treedef, paths = _tree_paths(tree)
+
+    def _write_shard(fpath, array):
+        """One shard, atomically, returning the CRC32 of its bytes."""
+        fault_point("checkpoint.before_shard", path=fpath)
+        with atomic_write(fpath, "wb",
+                          site="checkpoint.shard_write") as f:
+            np.save(f, np.asarray(array))
+            crc = f.crc32
+        return crc
+
     leaves = []
     for pstr, arr in zip(paths, flat):
         arr = jnp.asarray(arr)
@@ -97,25 +119,29 @@ def save_sharded(path, tree, step=None, extra=None):
                     continue
                 seen.add(win)
                 fname = f"shard{tag}_{len(shards)}.npy"
-                np.save(os.path.join(ldir, fname), np.asarray(shard.data))
-                shards.append({"file": fname,
+                crc = _write_shard(os.path.join(ldir, fname), shard.data)
+                shards.append({"file": fname, "crc32": crc,
                                "index": [list(w) for w in win]})
         else:
             fname = f"shard{tag}_0.npy"
-            np.save(os.path.join(ldir, fname), np.asarray(arr))
-            shards.append({"file": fname,
+            crc = _write_shard(os.path.join(ldir, fname), arr)
+            shards.append({"file": fname, "crc32": crc,
                            "index": _index_to_json(
                                (slice(None),) * arr.ndim, arr.shape)})
         leaves.append({"path": pstr, "id": lid,
                        "shape": list(arr.shape), "dtype": str(arr.dtype),
                        "shards": shards})
     manifest = {
-        "format": "paddle_tpu.sharded_checkpoint.v1",
+        "format": "paddle_tpu.sharded_checkpoint.v2",   # v2: shard crc32
         "leaves": leaves,          # structure is restored via leaf paths
         "step": None if step is None else int(step),
         "extra": extra or {},
     }
-    with open(os.path.join(path, f"manifest.{rank}.json"), "w") as f:
+    # written LAST and atomically: a readable manifest implies complete
+    # shards (the commit point within this directory)
+    fault_point("checkpoint.before_manifest", path=path)
+    with atomic_write(os.path.join(path, f"manifest.{rank}.json"), "w",
+                      site="checkpoint.manifest_write") as f:
         json.dump(manifest, f, indent=1)
     return manifest
 

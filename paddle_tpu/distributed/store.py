@@ -13,6 +13,7 @@ from __future__ import annotations
 import time
 
 from ..native import load_tcp_store_lib
+from ..resilience.retry import Deadline, backoff_delays
 
 __all__ = ["TCPStore"]
 
@@ -30,13 +31,39 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore master failed to bind :{port}")
             port = self._lib.ts_server_port(self._server)
         self.host, self.port = host, int(port)
-        self._client = self._lib.ts_client_connect(
-            host.encode(), self.port, float(timeout))
+        self._client = self._connect(host, int(port), float(timeout))
         if not self._client:
             self._close_server()
             raise TimeoutError(
                 f"TCPStore could not reach {host}:{self.port} "
                 f"within {timeout}s")
+
+    def _connect(self, host, port, timeout):
+        """Retry connect with jittered backoff until ``timeout`` expires.
+
+        Rendezvous is a race by construction — workers dial before the
+        master binds — so a refused connection is the EXPECTED first
+        outcome, not an error.  Each attempt gets a short slice of the
+        budget (fail fast, retry), backing off so a relaunched 100-host
+        job doesn't hammer the master in lockstep."""
+        dl = Deadline(timeout)
+        delays = backoff_delays(base=0.02, cap=1.0)
+        while True:
+            attempt_t = min(2.0, max(0.05, dl.remaining()))
+            client = self._lib.ts_client_connect(
+                host.encode(), port, attempt_t)
+            if client:
+                return client
+            from ..observability.metrics import default_registry
+
+            default_registry().counter(
+                "retry_attempts_total",
+                help="failed attempts retried with backoff",
+                labelnames=("name",)).labels(
+                    name="TCPStore.connect").inc()
+            if dl.expired():
+                return None
+            dl.sleep(next(delays))
 
     # ------------------------------------------------------------------ kv
     def set(self, key: str, value):
@@ -50,11 +77,15 @@ class TCPStore:
         """Blocking get POLLS (client-side) rather than using the wire
         WAIT op: a server-side wait would hold this client's request
         mutex for its whole duration, deadlocking concurrent users of the
-        same store object (e.g. a heartbeat thread)."""
+        same store object (e.g. a heartbeat thread).  The poll backs off
+        exponentially (1ms → 100ms cap, jittered) instead of spinning at
+        a fixed 10ms — sub-ms latency for keys that are nearly there,
+        ~10 RPCs/s steady-state against a slow producer."""
         import ctypes
 
         buf = ctypes.create_string_buffer(1 << 20)
         deadline = time.time() + (timeout or self.timeout)
+        delays = backoff_delays(base=0.001, cap=0.1)
         while True:
             n = self._lib.ts_get(self._client, key.encode(), buf, len(buf))
             if n >= 0:
@@ -78,7 +109,7 @@ class TCPStore:
                 raise TimeoutError(
                     f"TCPStore.get({key!r}) timed out after "
                     f"{timeout or self.timeout}s")
-            time.sleep(0.01)
+            time.sleep(min(next(delays), max(0.0, deadline - time.time())))
 
     def add(self, key: str, delta: int = 1) -> int:
         import ctypes
@@ -224,6 +255,7 @@ class TCPStore:
         gen = (n - 1) // self.world_size   # re-usable barrier generations
         target = (gen + 1) * self.world_size
         deadline = time.time() + timeout
+        delays = backoff_delays(base=0.001, cap=0.05)
         cur = n
         while True:
             import ctypes
@@ -238,7 +270,7 @@ class TCPStore:
             if time.time() > deadline:
                 raise TimeoutError(f"barrier {name!r} timed out "
                                    f"({cur}/{target})")
-            time.sleep(0.01)
+            time.sleep(next(delays))
 
     def __del__(self):
         try:

@@ -6,12 +6,12 @@ Optimizer.state_dict(), and arbitrary nested containers.
 """
 from __future__ import annotations
 
-import os
 import pickle
 
 import numpy as np
 
 from .core.tensor import Tensor
+from .resilience.atomic import atomic_write
 
 __all__ = ["save", "load"]
 
@@ -44,10 +44,10 @@ class _TensorPayload:
 
 
 def save(obj, path, protocol=4):
-    d = os.path.dirname(os.path.abspath(path))
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    """Atomic: bytes land in a same-directory tmp file and ``os.replace``
+    publishes them, so a crash mid-``pickle.dump`` never corrupts an
+    existing checkpoint at ``path``."""
+    with atomic_write(path, "wb", site="framework_io.save") as f:
         pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
 
 

@@ -1,8 +1,10 @@
 """hapi — the high-level Model.fit API (parity: python/paddle/hapi/)."""
 from . import callbacks
-from .callbacks import (Callback, EarlyStopping, LRScheduler,
-                        ModelCheckpoint, ProfilerCallback, ProgBarLogger)
+from .callbacks import (Callback, CheckpointCallback, EarlyStopping,
+                        LRScheduler, ModelCheckpoint, ProfilerCallback,
+                        ProgBarLogger)
 from .model import Model
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "ProfilerCallback", "callbacks"]
+           "CheckpointCallback", "EarlyStopping", "LRScheduler",
+           "ProfilerCallback", "callbacks"]

@@ -1,5 +1,6 @@
 """hapi callbacks (parity: python/paddle/hapi/callbacks.py — Callback,
-ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler)."""
+ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler; plus the
+crash-safe CheckpointCallback backing ``Model.fit(resume_from=...)``)."""
 from __future__ import annotations
 
 import os
@@ -9,7 +10,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "ProfilerCallback", "CallbackList"]
+           "LRScheduler", "ProfilerCallback", "CheckpointCallback",
+           "CallbackList"]
 
 
 class Callback:
@@ -203,6 +205,155 @@ class ProfilerCallback(Callback):
 
     def on_train_end(self, logs=None):
         self.profiler.stop()
+
+
+def _pack_fit_state(model):
+    """One pytree holding everything a killed ``fit`` needs to continue:
+    params, buffers, functional optimizer state, and the stateful RNG
+    streams (keys stored as raw uint32 key-data so they survive the
+    .npy roundtrip bitwise)."""
+    import jax
+
+    from ..core.random import get_rng_state
+
+    params, buffers = model.network.raw_state()
+    tree = {"params": dict(params), "buffers": dict(buffers)}
+    if model._opt_state is not None:
+        tree["opt"] = model._opt_state
+    rng, counters = {}, {}
+    for name, (key, counter) in get_rng_state().items():
+        rng[name] = jax.random.key_data(key)
+        counters[name] = int(counter)
+    tree["rng"] = rng
+    return tree, counters
+
+
+def _unflatten(flat):
+    """path→leaf dict (load_sharded host form) back to nested dicts."""
+    out = {}
+    for path, leaf in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return out
+
+
+def _overlay(template, restored):
+    """Template-shaped copy with every leaf present in ``restored``
+    swapped in.  Needed because empty slot dicts (SGD has no slots)
+    carry no leaves, so they vanish from a flat checkpoint — the
+    optimizer's ``init_state`` re-supplies the structure."""
+    import jax.numpy as jnp
+
+    if isinstance(template, dict):
+        sub = restored if isinstance(restored, dict) else {}
+        return {k: _overlay(v, sub.get(k)) for k, v in template.items()}
+    return template if restored is None else jnp.asarray(restored)
+
+
+def _apply_fit_state(model, tree, extra):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.random import set_rng_state
+
+    named = dict(model.network.named_parameters())
+    for k, v in tree.get("params", {}).items():
+        named[k].data = jnp.asarray(v)
+    named_b = {k: b for k, b in model.network.named_buffers()
+               if b is not None}
+    for k, v in tree.get("buffers", {}).items():
+        if k in named_b:
+            named_b[k].data = jnp.asarray(v)
+    opt = model._optimizer
+    if opt is not None and hasattr(opt, "init_state"):
+        params_tree = {k: p.data for k, p in named.items()}
+        model._opt_state = _overlay(opt.init_state(params_tree),
+                                    tree.get("opt", {}))
+    elif "opt" in tree:
+        model._opt_state = jax.tree_util.tree_map(jnp.asarray, tree["opt"])
+    counters = extra.get("rng_counters", {})
+    snapshot = {}
+    for name, key_data in tree.get("rng", {}).items():
+        key = jax.random.wrap_key_data(jnp.asarray(key_data, jnp.uint32))
+        snapshot[name] = (key, int(counters.get(name, 0)))
+    if snapshot:
+        set_rng_state(snapshot)
+
+
+def restore_fit_state(model, resume_from):
+    """Restore the newest intact fit checkpoint under ``resume_from``
+    into ``model``.  Returns the manifest ``extra`` dict (epoch /
+    next_step / global_step) or None when no checkpoint exists yet —
+    first launch and relaunch-after-crash are then the same code path."""
+    from ..resilience import CheckpointManager
+
+    mgr = resume_from if isinstance(resume_from, CheckpointManager) \
+        else CheckpointManager(resume_from)
+    try:
+        _, flat, manifest = mgr.restore()
+    except FileNotFoundError:
+        return None
+    extra = manifest.get("extra", {})
+    _apply_fit_state(model, _unflatten(flat), extra)
+    return dict(extra)
+
+
+class CheckpointCallback(Callback):
+    """Crash-safe periodic checkpointing for ``Model.fit``.
+
+    Every ``every_n_steps`` train batches the full fit state (params,
+    buffers, optimizer state, RNG streams) is committed atomically via
+    :class:`paddle_tpu.resilience.CheckpointManager` — kill the process
+    at any instant and ``fit(resume_from=save_dir)`` continues from the
+    last committed step with a loss curve matching the uninterrupted
+    run.  ``keep_last_n`` bounds disk; ``async_save`` moves the write
+    off the training thread (the device→host snapshot stays
+    synchronous, so the saved state is still step-consistent).
+    """
+
+    def __init__(self, save_dir=None, every_n_steps=10, keep_last_n=3,
+                 async_save=False, manager=None):
+        super().__init__()
+        if manager is None:
+            from ..resilience import CheckpointManager
+
+            if save_dir is None:
+                raise ValueError("CheckpointCallback needs save_dir "
+                                 "or manager")
+            manager = CheckpointManager(save_dir, keep_last_n=keep_last_n,
+                                        async_save=async_save)
+        self.manager = manager
+        self.every_n_steps = int(every_n_steps)
+        self._epoch = 0
+        self._global_step = 0
+
+    def on_train_begin(self, logs=None):
+        info = getattr(self.model, "_resume_info", None) or {}
+        self._global_step = int(info.get("global_step", 0))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._global_step % self.every_n_steps == 0:
+            self._save(next_step=step + 1)
+
+    def on_train_end(self, logs=None):
+        self.manager.wait()        # surface a failed async save here
+
+    def _save(self, next_step):
+        tree, rng_counters = _pack_fit_state(self.model)
+        self.manager.save(tree, step=self._global_step, extra={
+            "kind": "hapi_fit",
+            "epoch": self._epoch,
+            "next_step": next_step,
+            "global_step": self._global_step,
+            "rng_counters": rng_counters,
+        })
 
 
 class LRScheduler(Callback):

@@ -22,6 +22,8 @@ from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..observability.compile_watchdog import watch
 from ..profiler.profiler import RecordEvent
+from ..resilience.atomic import atomic_write
+from ..resilience.faults import fault_point
 from .callbacks import CallbackList, ProgBarLogger
 
 __all__ = ["Model"]
@@ -223,13 +225,33 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
-            verbose=1, shuffle=True, callbacks=None, **kw):
+            verbose=1, shuffle=True, callbacks=None, resume_from=None,
+            **kw):
         """Reference: hapi/model.py:907.
 
         Under a dp mesh, a user-supplied DataLoader may yield a ragged
         tail batch; _shard_batch trims it to the largest dp multiple
         (or pads a smaller-than-dp batch by repeating the last sample)
-        instead of raising mid-epoch."""
+        instead of raising mid-epoch.
+
+        ``resume_from``: a directory previously written by
+        :class:`~paddle_tpu.hapi.CheckpointCallback` (or its
+        CheckpointManager).  The newest intact checkpoint restores
+        params, optimizer state, and RNG streams, and the loop fast-
+        forwards to the saved (epoch, step) — so a killed run relaunched
+        with the same arguments continues its loss curve as if never
+        interrupted.  An empty directory is not an error (first launch
+        and crash-relaunch share one code path)."""
+        resume_epoch, resume_step = 0, 0
+        self._resume_info = None   # don't let a previous fit's resume leak
+        if resume_from is not None:
+            from .callbacks import restore_fit_state
+
+            info = restore_fit_state(self, resume_from)
+            if info is not None:
+                self._resume_info = info
+                resume_epoch = int(info.get("epoch", 0))
+                resume_step = int(info.get("next_step", 0))
         train_loader = self._loader(train_data, batch_size, shuffle)
         eval_loader = self._loader(eval_data, batch_size, False)
         cbs = _to_list(callbacks)
@@ -251,17 +273,22 @@ class Model:
         cblist.on_train_begin()
         history = []
         logs = {}
-        for epoch in range(epochs):
+        for epoch in range(resume_epoch, epochs):
             cblist.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
             for step, batch in enumerate(train_loader):
+                if epoch == resume_epoch and step < resume_step:
+                    continue           # already trained before the crash
                 cblist.on_train_batch_begin(step)
                 x, y = batch[0], batch[1]
                 loss, res = self.train_batch(x, y)
                 logs = {"loss": loss, **res}
                 cblist.on_train_batch_end(step, logs)
+                # simulated-preemption site: crash-consistency tests kill
+                # fit here, AFTER the checkpoint callback ran for this step
+                fault_point("hapi.train_step")
                 if self.stop_training:
                     break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
@@ -310,22 +337,24 @@ class Model:
 
     def save(self, path):
         """Save params (+ optimizer state when prepared) —
-        reference: model.save(path) → path.pdparams / path.pdopt."""
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
+        reference: model.save(path) → path.pdparams / path.pdopt.
+        Atomic per file: a crash mid-save can't corrupt a previous
+        checkpoint under the same path."""
         params, buffers = self.network.raw_state()
         blob = {"params": {k: np.asarray(v) for k, v in params.items()},
                 "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
-        with open(path + ".pdparams", "wb") as f:
+        with atomic_write(path + ".pdparams", "wb",
+                          site="hapi.model_save") as f:
             pickle.dump(blob, f, protocol=4)
         if self._opt_state is not None:
             blob_opt = jax.tree_util.tree_map(np.asarray, self._opt_state)
-            with open(path + ".pdopt", "wb") as f:
+            with atomic_write(path + ".pdopt", "wb",
+                              site="hapi.model_save") as f:
                 pickle.dump(blob_opt, f, protocol=4)
         elif self._optimizer is not None and \
                 hasattr(self._optimizer, "state_dict"):
-            with open(path + ".pdopt", "wb") as f:
+            with atomic_write(path + ".pdopt", "wb",
+                              site="hapi.model_save") as f:
                 pickle.dump(self._optimizer.state_dict(), f, protocol=4)
 
     def load(self, path):

@@ -129,7 +129,8 @@ def save(layer, path, input_spec=None, example_inputs=None):
     """
     import json
 
-    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    from ..resilience.atomic import atomic_write
+
     meta = {"class": type(layer).__name__}
     if isinstance(layer, TracedLayer):
         traced, target = layer, layer.target
@@ -139,10 +140,12 @@ def save(layer, path, input_spec=None, example_inputs=None):
         params, buffers = target.raw_state()
     else:
         params, buffers = {}, {}
-    np.savez(path + ".pdiparams.npz",
-             **{k: np.asarray(v) for k, v in params.items()})
-    np.savez(path + ".pdibuffers.npz",
-             **{k: np.asarray(v) for k, v in buffers.items()})
+    with atomic_write(path + ".pdiparams.npz", "wb",
+                      site="jit.save") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in params.items()})
+    with atomic_write(path + ".pdibuffers.npz", "wb",
+                      site="jit.save") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in buffers.items()})
     meta["keys"] = list(params)
     if example_inputs is not None:
         arr_args = traced._unwrap(tuple(example_inputs))
@@ -155,14 +158,15 @@ def save(layer, path, input_spec=None, example_inputs=None):
             exported = exp(params, buffers, *arr_args)
         else:
             exported = exp(*arr_args)
-        with open(path + ".pdmodel", "wb") as f:
+        with atomic_write(path + ".pdmodel", "wb", site="jit.save") as f:
             f.write(bytes(exported.serialize()))
-        with open(path + ".stablehlo", "w") as f:
+        with atomic_write(path + ".stablehlo", "w", site="jit.save") as f:
             # reuse the exported module text — no second trace/lower pass
             f.write(exported.mlir_module())
         meta["has_program"] = True
         meta["program_takes_state"] = traced.is_layer
-    with open(path + ".pdmodel.json", "w") as f:
+    # metadata last: it is the artifact's commit marker
+    with atomic_write(path + ".pdmodel.json", "w", site="jit.save") as f:
         json.dump(meta, f)
 
 

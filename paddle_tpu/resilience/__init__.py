@@ -1,0 +1,57 @@
+"""paddle_tpu.resilience — correctness under failure.
+
+The north star serves millions of users from preemptible TPU fleets;
+this package is the fault boundary that makes that survivable:
+
+- :mod:`.atomic` — the tmp+rename write primitive every durable file
+  in the repo commits through (linted by
+  ``tools/check_atomic_writes.py``).
+- :mod:`.checkpoint_manager` — :class:`CheckpointManager`: atomic
+  commit, per-shard CRC32, ``latest()`` discovery that skips torn or
+  corrupt checkpoints, newest-intact fallback restore, ``keep_last_n``
+  retention, optional background async save.
+- :mod:`.faults` — deterministic seed-driven fault injection (named
+  sites, off by default, env-gated via ``PADDLE_TPU_FAULTS``); drives
+  the crash-consistency tests and counts every fired fault into the
+  metrics registry.
+- :mod:`.retry` — jittered exponential backoff (:func:`retry`,
+  :func:`backoff_delays`) and :class:`Deadline`, adopted by the
+  TCPStore client and the serving engine's per-request TTLs.
+
+Consumers: ``framework_io.save`` and ``jit.save`` write atomically;
+``distributed.checkpoint`` checksums shards and exposes kill sites;
+``hapi.CheckpointCallback`` + ``Model.fit(resume_from=...)`` make a
+killed training run continue with a matching loss curve; the serving
+engine sheds load at watermarks and evicts requests past deadline.
+"""
+from __future__ import annotations
+
+from .atomic import CRC32Writer, atomic_write  # noqa: F401
+from .checkpoint_manager import (  # noqa: F401
+    CheckpointManager,
+    verify_checkpoint,
+)
+from .faults import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    SimulatedCrash,
+    current_injector,
+    fault_point,
+    injected_faults,
+    install,
+    install_from_env,
+    uninstall,
+)
+from .retry import Deadline, RetryError, backoff_delays, retry  # noqa: F401
+
+__all__ = [
+    "atomic_write", "CRC32Writer",
+    "CheckpointManager", "verify_checkpoint",
+    "FaultInjector", "FaultSpec", "SimulatedCrash", "fault_point",
+    "install", "uninstall", "current_injector", "injected_faults",
+    "install_from_env",
+    "Deadline", "RetryError", "backoff_delays", "retry",
+]
+
+# env-gated fault injection: inert unless PADDLE_TPU_FAULTS is set
+install_from_env()

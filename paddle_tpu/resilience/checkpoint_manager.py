@@ -1,0 +1,247 @@
+"""Crash-safe checkpoint lifecycle over ``distributed.checkpoint``.
+
+``save_sharded`` knows how to lay one pytree down as shard files + a
+manifest; this module owns everything around that write that makes a
+*sequence* of checkpoints survive being killed at any instant:
+
+- **atomic commit** — each save lands in ``<dir>/step_<n>.tmp`` (shards
+  checksummed, manifest written last, itself via tmp+rename), then ONE
+  ``os.replace`` publishes the directory as ``<dir>/step_<n>``.  There
+  is no moment at which a reader can see a half-written checkpoint
+  under a committed name.
+- **checksums** — every shard's CRC32 (of the exact bytes written) is
+  recorded in the manifest; :func:`verify_checkpoint` recomputes them,
+  and restore refuses a checkpoint whose bytes rotted after commit.
+- **discovery** — :meth:`CheckpointManager.latest` scans for committed
+  steps, skipping ``.tmp`` leftovers and (with ``verify=True``)
+  corrupt directories.
+- **fallback restore** — :meth:`CheckpointManager.restore` walks
+  newest→oldest until a checkpoint passes verification, so one damaged
+  checkpoint degrades recovery by one save interval, not to zero.
+- **retention** — ``keep_last_n`` garbage-collects old committed steps
+  after each successful commit (tmp droppings from crashed saves are
+  swept opportunistically too).
+- **async save** — ``async_save=True`` snapshots the tree to host
+  memory synchronously (device arrays are mutable-in-place from the
+  trainer's view) and writes + commits on a background thread;
+  :meth:`wait` joins it and re-raises its failure.  The training
+  thread pays device→host copy time, not disk time.
+
+Fault sites (see ``resilience.faults``): ``checkpoint.before_shard``,
+``checkpoint.shard_write``, ``checkpoint.before_manifest``,
+``checkpoint.manifest_write``, ``checkpoint.before_commit``,
+``checkpoint.after_commit``.
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import threading
+import zlib
+
+from .faults import fault_point
+
+__all__ = ["CheckpointManager", "verify_checkpoint"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dirname(step):
+    return f"step_{int(step):010d}"
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+def verify_checkpoint(path):
+    """Recompute every shard's CRC32 against the manifest.
+
+    Returns ``(ok, errors)``; a checkpoint with no manifest, a missing
+    shard file, or any checksum mismatch fails.  Shard entries written
+    before checksums existed (no ``crc32`` key) are accepted — age is
+    not corruption."""
+    from ..distributed.checkpoint import _load_manifest
+
+    errors = []
+    try:
+        manifest = _load_manifest(path)
+    except (OSError, ValueError) as e:
+        return False, [f"manifest unreadable: {e}"]
+    for leaf in manifest.get("leaves", []):
+        for sh in leaf["shards"]:
+            fpath = os.path.join(path, leaf["id"], sh["file"])
+            want = sh.get("crc32")
+            try:
+                got = _file_crc32(fpath)
+            except OSError as e:
+                errors.append(f"{leaf['path']}/{sh['file']}: {e}")
+                continue
+            if want is not None and got != want:
+                errors.append(
+                    f"{leaf['path']}/{sh['file']}: crc32 {got:#010x} != "
+                    f"manifest {want:#010x}")
+    return not errors, errors
+
+
+class CheckpointManager:
+    """Atomic, checksummed, retained checkpoints under one directory."""
+
+    def __init__(self, directory, keep_last_n=None, async_save=False):
+        self.directory = os.fspath(directory)
+        self.keep_last_n = keep_last_n
+        self.async_save = bool(async_save)
+        self._thread = None
+        self._error = None
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------ discovery
+    def steps(self):
+        """Committed step numbers, ascending (no verification)."""
+        out = []
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in entries:
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def step_path(self, step):
+        return os.path.join(self.directory, _step_dirname(step))
+
+    def latest(self, verify=True):
+        """Newest committed (and, with ``verify``, intact) step number,
+        or None.  Corrupt/uncommitted directories are skipped, newest
+        first — this is what a restarted trainer calls to find where to
+        resume."""
+        for step in reversed(self.steps()):
+            if not verify:
+                return step
+            ok, _ = verify_checkpoint(self.step_path(step))
+            if ok:
+                return step
+            self._count("checkpoint_corrupt_skipped_total")
+        return None
+
+    # --------------------------------------------------------------- save
+    def save(self, tree, step, extra=None):
+        """Checkpoint ``tree`` as ``step``.  With ``async_save`` the
+        device→host snapshot happens now and the write/commit happens on
+        a background thread (a previous in-flight save is joined first,
+        so saves never reorder)."""
+        self.wait()
+        if not self.async_save:
+            self._write_and_commit(tree, step, extra)
+            return self.step_path(step)
+        import jax
+
+        host_tree = jax.device_get(tree)
+        self._thread = threading.Thread(
+            target=self._bg_save, args=(host_tree, step, extra),
+            name=f"ckpt-save-{step}", daemon=True)
+        self._thread.start()
+        return self.step_path(step)
+
+    def _bg_save(self, tree, step, extra):
+        try:
+            self._write_and_commit(tree, step, extra)
+        except BaseException as e:          # surfaced by wait()/next save
+            self._error = e
+
+    def wait(self):
+        """Join an in-flight async save; re-raise its failure here (the
+        training thread is the one that must learn the save died)."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def _write_and_commit(self, tree, step, extra):
+        from ..distributed.checkpoint import save_sharded
+
+        final = self.step_path(step)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):             # debris from a crashed save
+            shutil.rmtree(tmp)
+        save_sharded(tmp, tree, step=int(step), extra=extra)
+        fault_point("checkpoint.before_commit", path=tmp)
+        if os.path.isdir(final):
+            # re-saving a step that already exists on disk is legitimate
+            # (restore fell back past a corrupt step N and retrained to
+            # it, or a crashed run's async save committed after the
+            # trainer restored an older step): the new bytes supersede.
+            # os.replace cannot rename over a non-empty dir, so clear it
+            # — a crash in between costs only this one step; older
+            # committed steps still restore.
+            shutil.rmtree(final)
+        os.replace(tmp, final)              # THE commit point
+        fault_point("checkpoint.after_commit", path=final)
+        self._count("checkpoint_commits_total")
+        self._gc()
+
+    # ------------------------------------------------------------- restore
+    def restore(self, like_tree=None, step=None, verify=True):
+        """Load the newest intact checkpoint (or exactly ``step``).
+
+        Returns ``(step, tree, manifest)``; ``like_tree`` follows
+        ``load_sharded`` semantics (sharded rebuild vs host dict).
+        Walks back over corrupt checkpoints unless pinned to ``step``
+        (an explicitly requested broken checkpoint should fail loudly).
+        Raises FileNotFoundError when nothing restorable exists."""
+        from ..distributed.checkpoint import load_sharded
+
+        candidates = [step] if step is not None else \
+            list(reversed(self.steps()))
+        last_err = None
+        for s in candidates:
+            path = self.step_path(s)
+            if verify:
+                ok, errors = verify_checkpoint(path)
+                if not ok:
+                    if step is not None:
+                        raise ValueError(
+                            f"checkpoint step {s} failed verification: "
+                            + "; ".join(errors))
+                    self._count("checkpoint_corrupt_skipped_total")
+                    last_err = errors
+                    continue
+            tree, manifest = load_sharded(path, like_tree=like_tree)
+            return s, tree, manifest
+        detail = f" (newest candidate errors: {last_err})" if last_err \
+            else ""
+        raise FileNotFoundError(
+            f"no intact checkpoint under {self.directory!r}{detail}")
+
+    # ----------------------------------------------------------- retention
+    def _gc(self):
+        for name in os.listdir(self.directory):
+            if name.endswith(".tmp"):
+                full = os.path.join(self.directory, name)
+                # a foreign pid may still be writing; only sweep our
+                # naming scheme's directories
+                if _STEP_RE.match(name[:-4]) and os.path.isdir(full):
+                    shutil.rmtree(full, ignore_errors=True)
+        if self.keep_last_n is None:
+            return
+        steps = self.steps()
+        for s in steps[:max(0, len(steps) - int(self.keep_last_n))]:
+            shutil.rmtree(self.step_path(s), ignore_errors=True)
+            self._count("checkpoint_gc_removed_total")
+
+    @staticmethod
+    def _count(name):
+        from ..observability.metrics import default_registry
+
+        default_registry().counter(name).inc()

@@ -1,0 +1,194 @@
+"""Deterministic fault injection — the crash-consistency test driver.
+
+Production TPU fleets treat preemption as routine (the reference's
+elastic manager relaunches on ``ELASTIC_EXIT_CODE=101``); the only way
+to know recovery works is to kill the process at every interesting
+boundary and check what restore finds.  This module provides *named
+fault sites* threaded through the I/O and checkpoint paths — each site
+calls :func:`fault_point` with its name, and an installed
+:class:`FaultInjector` decides (deterministically) whether to fire.
+
+Fault kinds:
+
+``kill``
+    Raise :class:`SimulatedCrash` (a ``BaseException`` so ordinary
+    ``except Exception`` recovery code can't swallow it — exactly like
+    a SIGKILL, nothing downstream of the site runs).
+``torn_write``
+    Truncate the file named by the site's ``path`` to a seed-chosen
+    fraction of its bytes, then crash — a torn write only matters when
+    the process dies before completing it.
+``io_error``
+    Raise a transient ``OSError`` (recoverable: retry decorators and
+    callers see a plain failure, the process survives).
+``stall``
+    Sleep ``stall_s`` seconds — an artificial host hiccup for deadline
+    and watchdog paths.
+
+Everything is **off by default**: with no injector installed,
+``fault_point`` is a dict lookup and a return.  Installation is
+programmatic (:func:`install` / :func:`uninstall`, or the
+:func:`injected_faults` context manager tests use) or via the
+``PADDLE_TPU_FAULTS`` env var (``site:kind:occurrence[,...]``), read
+once by :func:`install_from_env`.
+
+Every fired fault increments ``faults_injected_total{site=,kind=}`` in
+the default metrics registry, so a fault-injection run's telemetry
+shows exactly what was injected where.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+__all__ = ["SimulatedCrash", "FaultSpec", "FaultInjector", "fault_point",
+           "install", "uninstall", "current_injector", "injected_faults",
+           "install_from_env"]
+
+
+class SimulatedCrash(BaseException):
+    """An injected process death.  Deliberately NOT an ``Exception``:
+    recovery code that catches ``Exception`` must not be able to
+    "survive" a simulated SIGKILL."""
+
+    def __init__(self, site, occurrence):
+        super().__init__(f"simulated crash at fault site {site!r} "
+                         f"(occurrence {occurrence})")
+        self.site = site
+        self.occurrence = occurrence
+
+
+class FaultSpec:
+    """Fire ``kind`` at the ``occurrence``-th hit (1-based) of ``site``.
+
+    ``torn_frac`` overrides the seed-derived truncation fraction for
+    ``torn_write``; ``stall_s`` sets the ``stall`` duration."""
+
+    def __init__(self, site, kind="kill", occurrence=1, torn_frac=None,
+                 stall_s=0.05):
+        if kind not in ("kill", "torn_write", "io_error", "stall"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self.site = site
+        self.kind = kind
+        self.occurrence = int(occurrence)
+        self.torn_frac = torn_frac
+        self.stall_s = stall_s
+
+    def __repr__(self):
+        return (f"FaultSpec({self.site!r}, {self.kind!r}, "
+                f"occurrence={self.occurrence})")
+
+
+class FaultInjector:
+    """Seed-driven injector: hit counts per site + the spec table.
+
+    The seed drives only *fault shape* (torn-write truncation point),
+    never *whether* a fault fires — firing is exact (site, occurrence)
+    matching so a failing kill point reproduces from its test id alone.
+    """
+
+    def __init__(self, specs=(), seed=0):
+        import numpy as np
+
+        self.specs = list(specs)
+        self._rng = np.random.default_rng(seed)
+        self._hits = {}          # site -> total hits
+        self._fired = []         # [(site, kind, occurrence)] audit log
+
+    # ------------------------------------------------------------ counters
+    def hits(self, site):
+        return self._hits.get(site, 0)
+
+    @property
+    def fired(self):
+        return list(self._fired)
+
+    # ------------------------------------------------------------- firing
+    def _record(self, site, kind, occ):
+        self._fired.append((site, kind, occ))
+        # lazy import: faults must be importable before the jax-adjacent
+        # observability stack (and from tools that never touch it)
+        from ..observability.metrics import default_registry
+
+        default_registry().counter(
+            "faults_injected_total",
+            help="faults fired by the resilience fault injector",
+            labelnames=("site", "kind")).labels(site=site, kind=kind).inc()
+
+    def on_fault_point(self, site, path=None):
+        occ = self._hits.get(site, 0) + 1
+        self._hits[site] = occ
+        for spec in self.specs:
+            if spec.site != site or spec.occurrence != occ:
+                continue
+            self._record(site, spec.kind, occ)
+            if spec.kind == "kill":
+                raise SimulatedCrash(site, occ)
+            if spec.kind == "torn_write":
+                if path is not None and os.path.exists(path):
+                    size = os.path.getsize(path)
+                    frac = (spec.torn_frac if spec.torn_frac is not None
+                            else float(self._rng.uniform(0.1, 0.9)))
+                    with open(path, "r+b") as f:
+                        f.truncate(max(0, int(size * frac)))
+                raise SimulatedCrash(site, occ)
+            if spec.kind == "io_error":
+                raise OSError(f"injected transient I/O error at {site!r} "
+                              f"(occurrence {occ})")
+            if spec.kind == "stall":
+                time.sleep(spec.stall_s)
+
+
+_injector: FaultInjector | None = None
+
+
+def install(injector: FaultInjector):
+    global _injector
+    _injector = injector
+    return injector
+
+
+def uninstall():
+    global _injector
+    _injector = None
+
+
+def current_injector():
+    return _injector
+
+
+@contextlib.contextmanager
+def injected_faults(*specs, seed=0):
+    """``with injected_faults(FaultSpec(...)):`` — install for a block,
+    always uninstall (even when the block dies of SimulatedCrash)."""
+    inj = install(FaultInjector(specs, seed=seed))
+    try:
+        yield inj
+    finally:
+        uninstall()
+
+
+def fault_point(site, path=None):
+    """Declare a named fault site.  No-op unless an injector is
+    installed AND a spec matches this site at the current hit count."""
+    if _injector is not None:
+        _injector.on_fault_point(site, path=path)
+
+
+def install_from_env(var="PADDLE_TPU_FAULTS"):
+    """Parse ``site:kind:occurrence[,site:kind:occurrence...]`` from the
+    environment and install an injector; returns it (None if unset).
+    Seed comes from ``PADDLE_TPU_FAULTS_SEED`` (default 0)."""
+    raw = os.environ.get(var, "").strip()
+    if not raw:
+        return None
+    specs = []
+    for item in raw.split(","):
+        parts = item.strip().split(":")
+        site = parts[0]
+        kind = parts[1] if len(parts) > 1 else "kill"
+        occ = int(parts[2]) if len(parts) > 2 else 1
+        specs.append(FaultSpec(site, kind, occurrence=occ))
+    seed = int(os.environ.get(var + "_SEED", "0"))
+    return install(FaultInjector(specs, seed=seed))

@@ -9,6 +9,13 @@ serving facade over the framework-wide metrics registry
 .enable_generation()`` + ``create_predictor`` expose it through the
 predictor API; ``bench.py --section serving`` measures tokens/sec and
 TTFT under a Poisson arrival trace.
+
+Overload behavior is part of the contract (README "Resilience"):
+infeasible requests are REJECTED hard at submit; with watermarks
+armed, feasible-but-unlucky ones get the soft RETRY_AFTER; requests
+with a TTL are EVICTED (pages freed, partial output kept) the moment
+a step starts past their deadline; the ``serving_engine_healthy``
+gauge tells ops which regime the engine is in.
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
 from .kv_cache import PagedKVCache  # noqa: F401

@@ -25,6 +25,21 @@ optimistic), the youngest running sequence is preempted back to the
 queue head and recomputed later — memory pressure degrades throughput,
 never correctness.
 
+Overload robustness (the production-traffic contract):
+
+- **load shedding** — with watermarks configured, crossing the HIGH
+  page-occupancy or queue-depth mark flips the engine to *degraded*:
+  new submissions return ``RequestState.RETRY_AFTER`` (a soft "come
+  back later", distinct from the hard ``REJECTED`` of an infeasible
+  request) until occupancy/queue fall below the LOW marks (hysteresis,
+  so the admit/shed decision doesn't flap per token).  The
+  ``serving_engine_healthy`` gauge mirrors the state for ops.
+- **deadlines** — a request with a TTL (``SamplingParams.ttl_s`` or
+  the engine's ``default_ttl_s``) is EVICTED the moment a step starts
+  past its deadline — mid-decode or still queued — freeing its pages
+  for requests that can still meet theirs.  A request nobody is
+  waiting for anymore is pure waste to keep decoding.
+
 Sampling is host-side (greedy / temperature / top-k / top-p) with a
 per-request numpy Generator seeded at submit, so outputs are
 deterministic for a fixed seed regardless of batch composition.
@@ -53,20 +68,25 @@ class RequestState:
     QUEUED = "queued"
     RUNNING = "running"
     FINISHED = "finished"
-    REJECTED = "rejected"
+    REJECTED = "rejected"      # hard: can never be served (infeasible)
+    RETRY_AFTER = "retry_after"  # soft: shed under load, resubmit later
+    EVICTED = "evicted"        # deadline/TTL passed before completion
 
 
 @dataclasses.dataclass
 class SamplingParams:
     """temperature == 0 is greedy (argmax); top_k/top_p only apply when
     sampling.  stop_token_ids end generation (the stop token is kept in
-    the output, reason "stop"); max_new_tokens caps it (reason "length")."""
+    the output, reason "stop"); max_new_tokens caps it (reason "length").
+    ttl_s bounds submit→finish wall time: past it the request is evicted
+    (reason "deadline") even mid-decode."""
     max_new_tokens: int = 16
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
     stop_token_ids: tuple = ()
+    ttl_s: float = None
 
 
 @dataclasses.dataclass
@@ -81,6 +101,7 @@ class Request:
     t_admitted: float = None
     t_first_token: float = None
     t_finished: float = None
+    deadline: float = None     # absolute engine-clock time, None = no TTL
     _rng: object = None
 
     @property
@@ -103,11 +124,34 @@ class Engine:
     benches and tests).  page_size/num_pages size the KV pool;
     max_batch_size fixes the decode batch (static shape); prefill_len
     fixes the prompt pad length (static shape, default cfg.max_seq_len).
+
+    Robustness knobs: ``default_ttl_s`` is the per-request deadline when
+    SamplingParams doesn't set one.  ``shed_occupancy_high/low`` (pool
+    fraction, 0..1) and ``shed_queue_high/low`` (queue depth) arm
+    watermark load shedding; lows default to 3/4 of their high.
+    ``clock`` replaces time.perf_counter (tests drive a manual clock so
+    deadline behavior is deterministic, not sleep-based).
     """
 
     def __init__(self, cfg: GPTConfig, params=None, *, page_size=16,
-                 num_pages=256, max_batch_size=4, prefill_len=None):
+                 num_pages=256, max_batch_size=4, prefill_len=None,
+                 default_ttl_s=None, shed_occupancy_high=None,
+                 shed_occupancy_low=None, shed_queue_high=None,
+                 shed_queue_low=None, clock=None):
         self.cfg = cfg
+        self._clock = clock or time.perf_counter
+        self.default_ttl_s = default_ttl_s
+        self.shed_occupancy_high = shed_occupancy_high
+        self.shed_occupancy_low = (
+            shed_occupancy_low if shed_occupancy_low is not None
+            else (None if shed_occupancy_high is None
+                  else 0.75 * shed_occupancy_high))
+        self.shed_queue_high = shed_queue_high
+        self.shed_queue_low = (
+            shed_queue_low if shed_queue_low is not None
+            else (None if shed_queue_high is None
+                  else max(0, int(0.75 * shed_queue_high))))
+        self._shedding = False
         self.params = params if params is not None else gpt_init(cfg)
         self.page_size = page_size
         self.max_batch_size = max_batch_size
@@ -151,10 +195,14 @@ class Engine:
         state is REJECTED immediately when it can never be served."""
         sampling = sampling or SamplingParams()
         req = Request(id=self._next_id, prompt=list(prompt),
-                      sampling=sampling, t_submit=time.perf_counter())
+                      sampling=sampling, t_submit=self._clock())
         self._next_id += 1
         req.tokens = list(req.prompt)
         req._rng = np.random.default_rng(sampling.seed)
+        ttl = sampling.ttl_s if sampling.ttl_s is not None \
+            else self.default_ttl_s
+        if ttl is not None:
+            req.deadline = req.t_submit + float(ttl)
         self.metrics.requests_submitted.inc()
 
         total = len(req.prompt) + sampling.max_new_tokens
@@ -176,8 +224,65 @@ class Engine:
             req.finish_reason = reason
             self.metrics.requests_rejected.inc()
             return req
+        if self._update_shedding():
+            # soft rejection: the request IS feasible, the engine is
+            # just saturated — a client should back off and resubmit
+            req.state = RequestState.RETRY_AFTER
+            req.finish_reason = (
+                f"load shed: occupancy {self.cache.occupancy():.2f}, "
+                f"queue depth {len(self._queue)} — retry later")
+            self.metrics.requests_shed.inc()
+            return req
         self._queue.append(req)
+        self._update_shedding()
         return req
+
+    # ----------------------------------------------------- load shedding
+    def _update_shedding(self):
+        """High/low-watermark hysteresis over page-pool occupancy and
+        queue depth; mirrors into the health gauge.  Returns the current
+        shedding state."""
+        occ, q = self.cache.occupancy(), len(self._queue)
+        high = ((self.shed_occupancy_high is not None
+                 and occ >= self.shed_occupancy_high)
+                or (self.shed_queue_high is not None
+                    and q >= self.shed_queue_high))
+        low = ((self.shed_occupancy_low is None
+                or occ <= self.shed_occupancy_low)
+               and (self.shed_queue_low is None
+                    or q <= self.shed_queue_low))
+        if not self._shedding and high:
+            self._shedding = True
+        elif self._shedding and low and not high:
+            self._shedding = False
+        self.metrics.engine_healthy.set(0 if self._shedding else 1)
+        return self._shedding
+
+    # -------------------------------------------------- deadline eviction
+    def _evict(self, req, now):
+        """Terminal deadline eviction: pages freed, partial output kept."""
+        if req in self._slots:
+            self.cache.free(req.id)
+            self._slots[self._slots.index(req)] = None
+        req.state = RequestState.EVICTED
+        req.finish_reason = "deadline"
+        req.t_finished = now
+        self.metrics.deadline_evictions.inc()
+        self._just_finished.append(req)
+
+    def _evict_expired(self):
+        """Evict every request (running OR still queued) whose deadline
+        has passed — run at step start so freed pages are available to
+        this step's admissions."""
+        now = self._clock()
+        for req in self._running():
+            if req.deadline is not None and now > req.deadline:
+                self._evict(req, now)
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now > r.deadline]
+        for req in expired:
+            self._queue.remove(req)
+            self._evict(req, now)
 
     # -------------------------------------------------------------- admit
     def _free_slot(self):
@@ -196,7 +301,7 @@ class Engine:
             if not self.cache.allocate(req.id, len(req.prompt) + 1):
                 return                       # FIFO: no queue-jumping
             self._queue.popleft()
-            now = time.perf_counter()
+            now = self._clock()
             req.state = RequestState.RUNNING
             req.t_admitted = now
             req._admit_seq = self._admit_seq
@@ -221,7 +326,7 @@ class Engine:
         self.metrics.prefill_tokens.inc(n)
         tok = self._sample_token(logits[0], req)
         req.tokens.append(tok)
-        req.t_first_token = time.perf_counter()
+        req.t_first_token = self._clock()
         self.metrics.ttft.observe(req.t_first_token - req.t_submit)
         self.metrics.tokens_generated.inc()
         self._maybe_finish(req)
@@ -268,7 +373,7 @@ class Engine:
             positions[i] = len(req.tokens) - 1
             seq_lens[i] = len(req.tokens)
             tables[i] = self.cache.page_table(req.id)
-        t0 = time.perf_counter()
+        t0 = self._clock()
         with RecordEvent("serving::decode"):
             logits, k, v = self._decode_fn(
                 self.params, self.cache.k_pages, self.cache.v_pages,
@@ -276,7 +381,7 @@ class Engine:
                 jnp.asarray(seq_lens), jnp.asarray(tables))
             logits = np.asarray(logits)
         self.cache.k_pages, self.cache.v_pages = k, v
-        dt = time.perf_counter() - t0
+        dt = self._clock() - t0
         n_active = len(running)
         for i, req in enumerate(self._slots):
             if req is None:
@@ -284,7 +389,7 @@ class Engine:
             tok = self._sample_token(logits[i], req)
             req.tokens.append(tok)
             if req.t_first_token is None:
-                req.t_first_token = time.perf_counter()
+                req.t_first_token = self._clock()
             self.metrics.tokens_generated.inc()
             self.metrics.decode_token.observe(dt / n_active)
             self._maybe_finish(req)
@@ -326,7 +431,7 @@ class Engine:
             return
         req.state = RequestState.FINISHED
         req.finish_reason = reason
-        req.t_finished = time.perf_counter()
+        req.t_finished = self._clock()
         self.cache.free(req.id)
         if req in self._slots:
             self._slots[self._slots.index(req)] = None
@@ -338,10 +443,13 @@ class Engine:
         return bool(self._queue) or any(r is not None for r in self._slots)
 
     def step(self):
-        """One scheduler iteration: admit, decode one token for the batch,
-        update gauges.  Returns requests that finished this step."""
+        """One scheduler iteration: evict past-deadline requests, admit,
+        decode one token for the batch, update gauges.  Returns requests
+        that finished (or were evicted) this step."""
+        self._evict_expired()
         self._try_admit()
         self._decode_once()
+        self._update_shedding()
         self.metrics.page_occupancy.set(self.cache.occupancy())
         done, self._just_finished = self._just_finished, []
         return done

@@ -49,6 +49,18 @@ class ServingMetrics:
         self.requests_finished = add(Counter("serving_requests_finished"))
         self.requests_rejected = add(Counter("serving_requests_rejected"))
         self.requests_preempted = add(Counter("serving_requests_preempted"))
+        self.requests_shed = add(Counter(
+            "serving_requests_shed_total",
+            help="requests refused with RETRY_AFTER by watermark "
+                 "load shedding"))
+        self.deadline_evictions = add(Counter(
+            "serving_deadline_evictions_total",
+            help="requests evicted (mid-decode or queued) past their "
+                 "deadline/TTL"))
+        self.engine_healthy = add(Gauge(
+            "serving_engine_healthy",
+            help="1 = healthy (admitting), 0 = degraded (shedding)"))
+        self.engine_healthy.set(1)
         self.prefill_tokens = add(Counter("serving_prefill_tokens"))
         self.tokens_generated = add(Counter("serving_tokens_generated"))
         self.queue_wait = add(Histogram("serving_queue_wait_s"))
@@ -64,7 +76,10 @@ class ServingMetrics:
                 "finished": self.requests_finished.value,
                 "rejected": self.requests_rejected.value,
                 "preempted": self.requests_preempted.value,
+                "shed": self.requests_shed.value,
+                "deadline_evicted": self.deadline_evictions.value,
             },
+            "engine_healthy": self.engine_healthy.value,
             "tokens": {
                 "prefill": self.prefill_tokens.value,
                 "generated": self.tokens_generated.value,
@@ -91,4 +106,6 @@ class ServingMetrics:
         occ = s["page_occupancy"]
         lines.append(f"{'page_occupancy':<16} current={occ['current']:.2f} "
                      f"peak={occ['peak']:.2f}")
+        lines.append(f"{'health':<16} "
+                     f"{'healthy' if s['engine_healthy'] else 'degraded'}")
         return "\n".join(lines)

@@ -421,7 +421,8 @@ class TestServingMetricsThinClient:
         snap = m.snapshot()
         assert snap["tokens"]["generated"] == 5
         assert set(snap) == {"requests", "tokens", "queue_wait_s",
-                             "ttft_s", "decode_token_s", "page_occupancy"}
+                             "ttft_s", "decode_token_s", "page_occupancy",
+                             "engine_healthy"}
 
 
 # ------------------------------------------------------------------- bench

@@ -1,0 +1,521 @@
+"""Resilience subsystem tests: atomic writes, deterministic fault
+injection, crash-safe checkpointing (kill at EVERY injected boundary),
+retry/backoff, TCPStore reconnection, and killed-and-resumed Model.fit
+reproducing the uninterrupted loss curve.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Callback, CheckpointCallback, Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.resilience import (CheckpointManager, Deadline,
+                                   FaultInjector, FaultSpec, RetryError,
+                                   SimulatedCrash, atomic_write,
+                                   backoff_delays, fault_point,
+                                   injected_faults, install_from_env,
+                                   retry, uninstall, verify_checkpoint)
+
+
+def _state(scale):
+    """A deterministic pytree; every leaf is a function of ``scale`` so a
+    restored checkpoint's provenance is readable off its values."""
+    return {"w": np.arange(16.0).reshape(4, 4) * scale,
+            "nested": {"b": np.full((3,), float(scale))},
+            "step_marker": np.asarray([scale], np.int64)}
+
+
+def _assert_state(tree, scale):
+    ref = _state(scale)
+    np.testing.assert_array_equal(tree["w"], ref["w"])
+    np.testing.assert_array_equal(tree["nested/b"], ref["nested"]["b"])
+    np.testing.assert_array_equal(tree["step_marker"], ref["step_marker"])
+
+
+# ------------------------------------------------------------ atomic IO
+
+
+class TestAtomicWrite:
+    def test_commit_and_crc(self, tmp_path):
+        p = tmp_path / "f.bin"
+        with atomic_write(str(p), "wb") as f:
+            f.write(b"hello ")
+            f.write(b"world")
+        assert p.read_bytes() == b"hello world"
+        import zlib
+
+        with atomic_write(str(p), "wb") as f:
+            f.write(b"checksummed")
+            crc = f.crc32
+        assert crc == zlib.crc32(b"checksummed")
+
+    def test_failure_leaves_target_untouched(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"old")
+        with pytest.raises(RuntimeError):
+            with atomic_write(str(p), "wb") as f:
+                f.write(b"new-partial")
+                raise RuntimeError("writer died")
+        assert p.read_bytes() == b"old"
+        # ordinary failures clean their tmp file up
+        assert list(tmp_path.iterdir()) == [p]
+
+    def test_append_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="append"):
+            with atomic_write(str(tmp_path / "f"), "ab"):
+                pass
+
+
+@pytest.mark.faultinject
+class TestFaultInjector:
+    def test_fires_only_at_matching_occurrence(self):
+        inj = FaultInjector([FaultSpec("site.a", "kill", occurrence=3)])
+        try:
+            paddle.resilience.install(inj)
+            fault_point("site.a")
+            fault_point("site.a")
+            fault_point("site.b")          # different site: never fires
+            with pytest.raises(SimulatedCrash) as ei:
+                fault_point("site.a")
+            assert ei.value.occurrence == 3
+            assert inj.hits("site.a") == 3
+            assert inj.fired == [("site.a", "kill", 3)]
+        finally:
+            uninstall()
+
+    def test_io_error_is_catchable_kill_is_not(self):
+        with injected_faults(FaultSpec("s", "io_error")):
+            with pytest.raises(OSError):
+                fault_point("s")
+        # a simulated SIGKILL must not be swallowable by the generic
+        # recovery idiom — it is deliberately not an Exception
+        assert not issubclass(SimulatedCrash, Exception)
+        with injected_faults(FaultSpec("s", "kill")):
+            with pytest.raises(SimulatedCrash):
+                fault_point("s")
+
+    def test_torn_write_truncates_deterministically(self, tmp_path):
+        sizes = []
+        for _ in range(2):
+            p = tmp_path / "t.bin"
+            p.write_bytes(bytes(1000))
+            with injected_faults(FaultSpec("s", "torn_write"), seed=7):
+                with pytest.raises(SimulatedCrash):
+                    fault_point("s", path=str(p))
+            sizes.append(p.stat().st_size)
+        assert sizes[0] == sizes[1]        # same seed → same torn length
+        assert 0 < sizes[0] < 1000
+
+    def test_stall_sleeps_and_counts(self):
+        from paddle_tpu.observability import default_registry
+
+        fam = default_registry().get("faults_injected_total")
+        before = fam.labels(site="s2", kind="stall").value if fam else 0
+        t0 = time.perf_counter()
+        with injected_faults(FaultSpec("s2", "stall", stall_s=0.05)):
+            fault_point("s2")
+        assert time.perf_counter() - t0 >= 0.045
+        fam = default_registry().get("faults_injected_total")
+        assert fam.labels(site="s2", kind="stall").value == before + 1
+
+    def test_env_gated_install(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FAULTS", "x.y:io_error:2")
+        inj = install_from_env()
+        try:
+            assert inj is not None
+            fault_point("x.y")
+            with pytest.raises(OSError):
+                fault_point("x.y")
+        finally:
+            uninstall()
+        monkeypatch.delenv("PADDLE_TPU_FAULTS")
+        assert install_from_env() is None
+
+
+# ---------------------------------------------------------------- retry
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        @retry(max_attempts=5, base=1e-4, cap=1e-3)
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return 42
+
+        assert flaky() == 42
+        assert len(calls) == 3
+
+    def test_exhaustion_raises_retry_error_chaining_last(self):
+        @retry(max_attempts=3, base=1e-4, cap=1e-3)
+        def doomed():
+            raise TimeoutError("always")
+
+        with pytest.raises(RetryError) as ei:
+            doomed()
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last, TimeoutError)
+
+    def test_non_retryable_exception_passes_through(self):
+        @retry(exceptions=(OSError,), max_attempts=5)
+        def typed():
+            raise ValueError("not retryable")
+
+        with pytest.raises(ValueError):
+            typed()
+
+    def test_deadline(self):
+        dl = Deadline(0.05)
+        assert not dl.expired()
+        assert dl.remaining() <= 0.05
+        dl.sleep(1.0)                      # clamped to the deadline
+        assert dl.expired() and dl.remaining() == 0.0
+        assert not Deadline(None).expired()
+
+    def test_backoff_delays_capped_and_jittered(self):
+        ds = backoff_delays(base=0.01, factor=2.0, cap=0.04, jitter=False)
+        assert [next(ds) for _ in range(5)] == \
+            [0.01, 0.02, 0.04, 0.04, 0.04]
+        import random
+
+        rng = random.Random(0)
+        ds = backoff_delays(base=0.01, cap=0.04, jitter=True, rng=rng)
+        vals = [next(ds) for _ in range(8)]
+        assert all(0.0 <= v <= 0.04 for v in vals)
+
+
+# ----------------------------------------------- crash-safe checkpoints
+
+
+class TestCheckpointManager:
+    def test_roundtrip_and_latest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.latest() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+        mgr.save(_state(1), step=1)
+        mgr.save(_state(2), step=2)
+        assert mgr.steps() == [1, 2] and mgr.latest() == 2
+        step, tree, manifest = mgr.restore()
+        assert step == 2 and manifest["step"] == 2
+        _assert_state(tree, 2)
+        # pinned restore of an older step
+        step, tree, _ = mgr.restore(step=1)
+        assert step == 1
+        _assert_state(tree, 1)
+
+    def test_resave_of_committed_step_supersedes(self, tmp_path):
+        """After a fallback restore (or an async save racing a crash) a
+        trainer legitimately re-reaches a step that already exists on
+        disk; the re-save must replace it, not ENOTEMPTY."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1), step=1)
+        mgr.save(_state(2), step=2)
+        mgr.save(_state(7), step=2)        # same step, new bytes
+        step, tree, _ = mgr.restore()
+        assert step == 2
+        _assert_state(tree, 7)
+
+    def test_retention_gc(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+        for i in (1, 2, 3, 4):
+            mgr.save(_state(i), step=i)
+        assert mgr.steps() == [3, 4]
+
+    def test_async_save(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(_state(1), step=1)
+        mgr.wait()
+        assert mgr.latest() == 1
+        step, tree, _ = mgr.restore()
+        _assert_state(tree, 1)
+
+    def test_corrupt_committed_checkpoint_falls_back(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1), step=1)
+        mgr.save(_state(2), step=2)
+        # bit-rot one shard of the newest checkpoint
+        p2 = mgr.step_path(2)
+        victim = next(os.path.join(r, f) for r, _, fs in os.walk(p2)
+                      for f in sorted(fs) if f.endswith(".npy"))
+        with open(victim, "r+b") as f:
+            f.seek(80)
+            f.write(b"\xff\xff\xff\xff")
+        ok, errors = verify_checkpoint(p2)
+        assert not ok and "crc32" in errors[0]
+        assert mgr.latest() == 1           # discovery skips corrupt
+        step, tree, _ = mgr.restore()      # restore falls back
+        assert step == 1
+        _assert_state(tree, 1)
+        with pytest.raises(ValueError, match="verification"):
+            mgr.restore(step=2)            # pinned: fail loudly
+
+
+@pytest.mark.faultinject
+class TestCrashConsistency:
+    """Kill the saver at every injected boundary: recovery must always
+    find the previous committed step, bitwise intact."""
+
+    KILL_POINTS = [
+        ("checkpoint.before_shard", 1),     # before any shard bytes
+        ("checkpoint.before_shard", 3),     # between shards
+        ("checkpoint.shard_write", 1),      # first shard committed-ish
+        ("checkpoint.shard_write", 2),      # mid shard sequence
+        ("checkpoint.before_manifest", 1),  # all shards, no manifest
+        ("checkpoint.manifest_write", 1),   # manifest bytes on disk,
+                                            # not yet renamed
+        ("checkpoint.before_commit", 1),    # dir complete, not renamed
+    ]
+
+    @pytest.mark.parametrize("site,occurrence", KILL_POINTS)
+    def test_kill_point_recovers_previous_step(self, tmp_path, site,
+                                               occurrence):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1), step=1)
+        with injected_faults(FaultSpec(site, "kill",
+                                       occurrence=occurrence)):
+            with pytest.raises(SimulatedCrash):
+                mgr.save(_state(2), step=2)
+        assert mgr.latest() == 1
+        step, tree, _ = mgr.restore()
+        assert step == 1
+        _assert_state(tree, 1)
+        # the interrupted save's debris must not block the next save
+        mgr.save(_state(2), step=2)
+        step, tree, _ = mgr.restore()
+        assert step == 2
+        _assert_state(tree, 2)
+
+    def test_torn_shard_write_never_commits(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1), step=1)
+        with injected_faults(FaultSpec("checkpoint.shard_write",
+                                       "torn_write", occurrence=2)):
+            with pytest.raises(SimulatedCrash):
+                mgr.save(_state(2), step=2)
+        assert mgr.latest() == 1
+        step, tree, _ = mgr.restore()
+        assert step == 1
+        _assert_state(tree, 1)
+
+    def test_transient_io_error_then_clean_retry(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        with injected_faults(FaultSpec("checkpoint.shard_write",
+                                       "io_error", occurrence=1)):
+            with pytest.raises(OSError):
+                mgr.save(_state(1), step=1)
+            mgr.save(_state(1), step=1)    # same injector: occurrence
+        step, tree, _ = mgr.restore()      # 1 already consumed
+        assert step == 1
+        _assert_state(tree, 1)
+
+    def test_framework_io_save_crash_keeps_old_blob(self, tmp_path):
+        path = str(tmp_path / "blob.pdparams")
+        paddle.save({"a": np.ones(4)}, path)
+        with injected_faults(FaultSpec("framework_io.save", "kill")):
+            with pytest.raises(SimulatedCrash):
+                paddle.save({"a": np.zeros(4)}, path)
+        out = paddle.load(path, return_numpy=True)
+        np.testing.assert_array_equal(out["a"], np.ones(4))
+
+
+# ------------------------------------------------ TCPStore retry/backoff
+
+
+class TestStoreBackoff:
+    def test_connect_retries_until_master_appears(self):
+        """Client dials BEFORE the master binds — rendezvous-order
+        robustness that a single connect attempt cannot provide."""
+        from paddle_tpu.distributed.store import TCPStore
+
+        # reserve a port, release it, then bind the master there late
+        probe = TCPStore(is_master=True, world_size=1)
+        port = probe.port
+        del probe
+        holder = {}
+
+        def late_master():
+            time.sleep(0.4)
+            holder["master"] = TCPStore(port=port, is_master=True,
+                                        world_size=2)
+
+        t = threading.Thread(target=late_master, daemon=True)
+        t.start()
+        client = TCPStore(port=port, is_master=False, world_size=2,
+                          timeout=15.0)
+        t.join()
+        holder["master"].set("k", b"v")
+        assert client.get("k", timeout=5) == b"v"
+
+    def test_connect_timeout_still_raises(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError):
+            TCPStore(host="127.0.0.1", port=1, is_master=False,
+                     timeout=0.5)
+        assert time.perf_counter() - t0 < 10.0
+
+    def test_blocking_get_backs_off_but_stays_responsive(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore(is_master=True, world_size=1)
+
+        def late_set():
+            time.sleep(0.3)
+            master.set("late", b"x")
+
+        threading.Thread(target=late_set, daemon=True).start()
+        t0 = time.perf_counter()
+        assert master.get("late", blocking=True, timeout=10) == b"x"
+        # exponential backoff caps at 100ms: arrival latency stays small
+        assert time.perf_counter() - t0 < 2.0
+
+
+# --------------------------------------- killed + resumed training run
+
+
+class _Toy(Dataset):
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = rng.randint(0, 2, (n,)).astype(np.int64)
+        self.x = (rng.randn(n, 8) * 0.3 +
+                  self.y[:, None].astype(np.float32) * 2.0
+                  ).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class _LossRecorder(Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(logs["loss"])
+
+
+def _fit_model(seed=3, lr=0.1):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net)
+    opt = paddle.optimizer.Momentum(learning_rate=lr,
+                                    parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    return model
+
+
+@pytest.mark.faultinject
+class TestFitAutoResume:
+    def test_killed_run_resumes_with_matching_loss_curve(self, tmp_path):
+        """2 epochs × 4 steps; kill at global step 6 (mid-epoch 2);
+        relaunch with resume_from → the combined loss trajectory equals
+        the uninterrupted run's, step for step."""
+        ref = _LossRecorder()
+        _fit_model().fit(_Toy(), batch_size=16, epochs=2, shuffle=False,
+                         verbose=0, callbacks=[ref])
+        assert len(ref.losses) == 8
+
+        ckdir = str(tmp_path / "ck")
+        part_a = _LossRecorder()
+        with injected_faults(FaultSpec("hapi.train_step", "kill",
+                                       occurrence=6)):
+            with pytest.raises(SimulatedCrash):
+                _fit_model().fit(
+                    _Toy(), batch_size=16, epochs=2, shuffle=False,
+                    verbose=0,
+                    callbacks=[part_a,
+                               CheckpointCallback(ckdir, every_n_steps=1)])
+        assert len(part_a.losses) == 6
+
+        # relaunch from scratch: DIFFERENT seed — restore must overwrite
+        part_b = _LossRecorder()
+        _fit_model(seed=99).fit(
+            _Toy(), batch_size=16, epochs=2, shuffle=False, verbose=0,
+            callbacks=[part_b, CheckpointCallback(ckdir, every_n_steps=1)],
+            resume_from=ckdir)
+        assert len(part_b.losses) == 2
+        np.testing.assert_allclose(part_a.losses + part_b.losses,
+                                   ref.losses, rtol=1e-5, atol=1e-6)
+
+    def test_resume_from_empty_dir_is_fresh_start(self, tmp_path):
+        hist = _fit_model().fit(_Toy(), batch_size=16, epochs=1,
+                                shuffle=False, verbose=0,
+                                resume_from=str(tmp_path / "none"))
+        assert len(hist) == 1
+
+    def test_resume_restores_rng_streams(self, tmp_path):
+        """The checkpoint carries the stateful RNG: a resumed run's draws
+        continue the killed run's sequence, not a fresh seed's."""
+        import jax
+
+        from paddle_tpu.core.random import split_key
+
+        mgr = CheckpointManager(str(tmp_path))
+        model = _fit_model()               # layer init draws; seed after
+
+        paddle.seed(7)
+        _ = [split_key() for _ in range(3)]
+        expected = jax.random.key_data(split_key())   # the 4th draw
+
+        paddle.seed(7)
+        _ = [split_key() for _ in range(3)]
+        from paddle_tpu.hapi.callbacks import (_pack_fit_state,
+                                               restore_fit_state)
+
+        tree, counters = _pack_fit_state(model)
+        mgr.save(tree, step=1, extra={"rng_counters": counters,
+                                      "epoch": 0, "next_step": 0,
+                                      "global_step": 1})
+        paddle.seed(12345)                   # clobber the stream
+        _ = [split_key() for _ in range(9)]
+        info = restore_fit_state(model, mgr)
+        assert info["global_step"] == 1
+        np.testing.assert_array_equal(jax.random.key_data(split_key()),
+                                      expected)
+
+
+# --------------------------------------------------- atomic-writes lint
+
+
+class TestAtomicWritesLint:
+    def test_repo_is_clean(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_atomic_writes",
+            os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                         "check_atomic_writes.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check() == []
+
+    def test_lint_catches_a_planted_violation(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_atomic_writes",
+            os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                         "check_atomic_writes.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad = tmp_path / "pkg" / "writer.py"
+        bad.parent.mkdir()
+        bad.write_text('def f(p):\n    with open(p, "wb") as fh:\n'
+                       '        fh.write(b"x")\n')
+        (tmp_path / "pkg" / "reader.py").write_text(
+            'def g(p):\n    return open(p).read()\n')
+        out = mod.check(root=str(tmp_path / "pkg"))
+        assert len(out) == 1 and "writer.py:2" in out[0]

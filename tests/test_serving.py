@@ -283,6 +283,158 @@ class TestEngine:
         assert snap["ttft_s"]["count"] == 1
 
 
+# -------------------------------------------- robustness under overload
+
+
+class _ManualClock:
+    """Deterministic engine clock: deadline tests advance time by hand
+    instead of sleeping."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+class TestDeadlineEviction:
+    def test_running_request_evicted_mid_decode(self, tiny_model):
+        cfg, params = tiny_model
+        clk = _ManualClock()
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, prefill_len=32, clock=clk)
+        req = eng.add_request(list(range(6)), SamplingParams(
+            max_new_tokens=50, ttl_s=5.0))
+        for _ in range(3):
+            clk.advance(1.0)
+            eng.step()
+        assert req.state == RequestState.RUNNING
+        produced = len(req.output)
+        assert produced >= 3
+        clk.advance(10.0)                  # now past the deadline
+        done = eng.step()
+        assert req in done
+        assert req.state == RequestState.EVICTED
+        assert req.finish_reason == "deadline"
+        assert len(req.output) == produced   # partial output preserved
+        # every page came back to the pool
+        assert eng.cache.num_free_pages == eng.cache.num_pages
+        assert eng.metrics.deadline_evictions.value == 1
+
+    def test_queued_request_past_deadline_never_admitted(self, tiny_model):
+        cfg, params = tiny_model
+        clk = _ManualClock()
+        # batch of 1: the second request waits in queue
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=1, prefill_len=32, clock=clk)
+        sp_long = SamplingParams(max_new_tokens=30)
+        sp_ttl = SamplingParams(max_new_tokens=4, ttl_s=2.0)
+        eng.add_request(list(range(5)), sp_long)
+        queued = eng.add_request(list(range(4)), sp_ttl)
+        clk.advance(5.0)                   # queued request expires unseen
+        eng.step()
+        assert queued.state == RequestState.EVICTED
+        assert queued.t_admitted is None   # evicted straight from queue
+        assert queued.output == []
+
+    def test_engine_default_ttl_applies(self, tiny_model):
+        cfg, params = tiny_model
+        clk = _ManualClock()
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, prefill_len=32, clock=clk,
+                     default_ttl_s=1.0)
+        req = eng.add_request(list(range(4)),
+                              SamplingParams(max_new_tokens=50))
+        assert req.deadline == pytest.approx(1.0)
+        clk.advance(2.0)
+        eng.step()
+        assert req.state == RequestState.EVICTED
+
+
+class TestWatermarkShedding:
+    def test_queue_depth_watermarks_with_hysteresis(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=1, prefill_len=32,
+                     shed_queue_high=3, shed_queue_low=1)
+        sp = SamplingParams(max_new_tokens=3)
+        reqs = [eng.add_request(list(range(4)), sp) for _ in range(6)]
+        states = [r.state for r in reqs]
+        # first three queue; hitting the high mark flips to shedding
+        assert states[:3] == [RequestState.QUEUED] * 3
+        assert states[3:] == [RequestState.RETRY_AFTER] * 3
+        shed = reqs[3]
+        assert shed.state != RequestState.REJECTED   # soft, not hard
+        assert "retry" in shed.finish_reason
+        assert eng.metrics.requests_shed.value == 3
+        assert eng.metrics.engine_healthy.value == 0   # degraded
+        # drain below the LOW mark: health recovers, admission resumes
+        while eng.has_work():
+            eng.step()
+        assert eng.metrics.engine_healthy.value == 1
+        ok = eng.add_request(list(range(4)), sp)
+        assert ok.state == RequestState.QUEUED
+        # admitted requests were unharmed by the overload
+        for r in reqs[:3]:
+            assert r.state == RequestState.FINISHED
+            assert len(r.output) == 3
+
+    def test_occupancy_watermark_sheds_until_pages_free(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=4,
+                     max_batch_size=2, prefill_len=16,
+                     shed_occupancy_high=0.5, shed_occupancy_low=0.25)
+        first = eng.add_request(list(range(10)),
+                                SamplingParams(max_new_tokens=4))
+        eng.step()                         # admitted: 2/4 pages in use
+        assert eng.cache.occupancy() >= 0.5
+        shed = eng.add_request(list(range(4)),
+                               SamplingParams(max_new_tokens=2))
+        assert shed.state == RequestState.RETRY_AFTER
+        while eng.has_work():
+            eng.step()                     # first finishes, pool drains
+        assert first.state == RequestState.FINISHED
+        late = eng.add_request(list(range(4)),
+                               SamplingParams(max_new_tokens=2))
+        assert late.state == RequestState.QUEUED
+
+    def test_admitted_requests_meet_deadlines_under_shedding(self,
+                                                            tiny_model):
+        """The graceful-degradation contract: with shedding armed, what
+        the engine ADMITS it finishes within TTL; overflow is shed with
+        the soft status instead of destroying everyone's latency."""
+        cfg, params = tiny_model
+        clk = _ManualClock()
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, prefill_len=32, clock=clk,
+                     default_ttl_s=60.0, shed_queue_high=2,
+                     shed_queue_low=0)
+        sp = SamplingParams(max_new_tokens=4)
+        reqs = [eng.add_request(list(range(4)), sp) for _ in range(8)]
+        while eng.has_work():
+            clk.advance(1.0)               # 1 "second" per decode step
+            eng.step()
+        admitted = [r for r in reqs if r.state == RequestState.FINISHED]
+        shed = [r for r in reqs if r.state == RequestState.RETRY_AFTER]
+        assert admitted and shed
+        assert len(admitted) + len(shed) == len(reqs)
+        for r in admitted:                 # no admitted request blew its
+            assert r.t_finished <= r.deadline   # deadline (none evicted)
+        assert eng.metrics.deadline_evictions.value == 0
+
+    def test_shedding_disabled_by_default(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=1, prefill_len=32)
+        sp = SamplingParams(max_new_tokens=2)
+        reqs = [eng.add_request(list(range(4)), sp) for _ in range(10)]
+        assert all(r.state == RequestState.QUEUED for r in reqs)
+        assert eng.metrics.engine_healthy.value == 1
+
+
 # --------------------------------------------------- satellite regressions
 
 
