@@ -342,15 +342,21 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
             continue
         eng.step()
     wall = time.perf_counter() - t0
+
+    def ms(v):                  # empty histogram stats are None
+        return v * 1e3 if v is not None else None
+
     snap = eng.metrics.snapshot()
     out = {
         "model": name, "requests": n_requests, "wall_s": wall,
         "tokens_per_sec": snap["tokens"]["generated"] / wall,
-        "ttft_ms_p50": snap["ttft_s"]["p50"] * 1e3,
-        "ttft_ms_p95": snap["ttft_s"]["p95"] * 1e3,
-        "queue_wait_ms_p50": snap["queue_wait_s"]["p50"] * 1e3,
-        "decode_token_ms_p50": snap["decode_token_s"]["p50"] * 1e3,
+        "ttft_ms_p50": ms(snap["ttft_s"]["p50"]),
+        "ttft_ms_p95": ms(snap["ttft_s"]["p95"]),
+        "queue_wait_ms_p50": ms(snap["queue_wait_s"]["p50"]),
+        "decode_token_ms_p50": ms(snap["decode_token_s"]["p50"]),
         "page_occupancy_peak": snap["page_occupancy"]["peak"],
+        "decode_rate_tok_s": eng.decode_rate(),
+        "estimated_drain_s": eng.estimated_drain_s(),
         "preempted": snap["requests"]["preempted"],
         "finished": snap["requests"]["finished"],
         "shed": snap["requests"]["shed"],
@@ -358,7 +364,8 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
         "engine_healthy": snap["engine_healthy"],
     }
     log(f"[serving] {out['tokens_per_sec']:.1f} tok/s, TTFT p50 "
-        f"{out['ttft_ms_p50']:.0f}ms p95 {out['ttft_ms_p95']:.0f}ms, "
+        f"{out['ttft_ms_p50'] or 0:.0f}ms p95 "
+        f"{out['ttft_ms_p95'] or 0:.0f}ms, "
         f"pool peak {out['page_occupancy_peak']*100:.0f}%, "
         f"shed {out['shed']}, deadline-evicted {out['deadline_evicted']}, "
         f"{'healthy' if out['engine_healthy'] else 'degraded'}")
@@ -414,16 +421,27 @@ def _section_telemetry(out):
     """Attach the global observability snapshot to one section's JSON:
     ``metrics`` is the default MetricsRegistry (serving counters, jit
     compile counters, ...), ``jit`` the compile watchdog's per-function
-    report (compiles/recompiles/compile wall-time/cost analysis).  The
-    watchdog is enabled at section start by _enable_watchdog."""
+    report (compiles/recompiles/compile wall-time/cost analysis),
+    ``traces`` the flight recorder's digest (per-root-name counts and
+    durations — serving request / hapi step spans), and ``resources``
+    one ResourceSampler reading (RSS / fds / GC / live jax bytes at
+    section end).  The watchdog is enabled at section start by
+    _enable_watchdog."""
     if not isinstance(out, dict):
         return out
-    from paddle_tpu.observability import default_registry, default_watchdog
+    from paddle_tpu.observability import (ResourceSampler,
+                                          default_registry,
+                                          default_tracer,
+                                          default_watchdog)
 
+    out["resources"] = ResourceSampler().sample_once()
     out["metrics"] = default_registry().snapshot()
     report = default_watchdog().report()
     if report:
         out["jit"] = report
+    trace_digest = default_tracer().summary()
+    if trace_digest["completed"]:
+        out["traces"] = trace_digest
     return out
 
 

@@ -228,6 +228,16 @@ def _pack_fit_state(model):
     return tree, counters
 
 
+def _lr_scheduler_of(model):
+    """The optimizer's attached LRScheduler, or None.  Its state
+    (last_epoch / last_lr — schedulers keep their own step counters) is
+    JSON-scalar, so it rides in the checkpoint manifest's ``extra``
+    rather than the array tree."""
+    opt = getattr(model, "_optimizer", None)
+    sched = getattr(opt, "_lr_scheduler", None)
+    return sched if hasattr(sched, "state_dict") else None
+
+
 def _unflatten(flat):
     """path→leaf dict (load_sharded host form) back to nested dicts."""
     out = {}
@@ -281,6 +291,12 @@ def _apply_fit_state(model, tree, extra):
         snapshot[name] = (key, int(counters.get(name, 0)))
     if snapshot:
         set_rng_state(snapshot)
+    sched_state = extra.get("lr_scheduler")
+    sched = _lr_scheduler_of(model)
+    if sched_state and sched is not None:
+        # restores last_epoch AND last_lr, so a stateful scheduler
+        # resumes exactly where the killed run stood — not one notch off
+        sched.set_state_dict(sched_state)
 
 
 def restore_fit_state(model, resume_from):
@@ -347,13 +363,17 @@ class CheckpointCallback(Callback):
 
     def _save(self, next_step):
         tree, rng_counters = _pack_fit_state(self.model)
-        self.manager.save(tree, step=self._global_step, extra={
+        extra = {
             "kind": "hapi_fit",
             "epoch": self._epoch,
             "next_step": next_step,
             "global_step": self._global_step,
             "rng_counters": rng_counters,
-        })
+        }
+        sched = _lr_scheduler_of(self.model)
+        if sched is not None:
+            extra["lr_scheduler"] = sched.state_dict()
+        self.manager.save(tree, step=self._global_step, extra=extra)
 
 
 class LRScheduler(Callback):

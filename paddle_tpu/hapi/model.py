@@ -273,6 +273,13 @@ class Model:
         cblist.on_train_begin()
         history = []
         logs = {}
+        # flight recorder: one root span per train step, carrying
+        # epoch/step — training and serving traces share one timeline
+        # vocabulary (a fit step and a request decode step correlate in
+        # the same chrome trace / /traces payload)
+        from ..observability.tracing import default_tracer
+
+        tracer = default_tracer()
         for epoch in range(resume_epoch, epochs):
             cblist.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -283,7 +290,10 @@ class Model:
                     continue           # already trained before the crash
                 cblist.on_train_batch_begin(step)
                 x, y = batch[0], batch[1]
-                loss, res = self.train_batch(x, y)
+                with tracer.trace("hapi::step",
+                                  {"epoch": epoch, "step": step}) as sp:
+                    loss, res = self.train_batch(x, y)
+                    sp.set_attribute("loss", float(loss))
                 logs = {"loss": loss, **res}
                 cblist.on_train_batch_end(step, logs)
                 # simulated-preemption site: crash-consistency tests kill

@@ -13,6 +13,19 @@ platform/monitor.h grown into a production observability stack):
   compilations, records compile wall-time + HLO cost analysis, and
   WARNs with the argument shape/dtype diff on post-warmup recompiles —
   the ragged-shape regression detector.
+- :mod:`.tracing` — the flight recorder: a thread-safe
+  :class:`Span`/:class:`Tracer` model with a bounded ring of completed
+  traces.  The serving engine records every request's lifecycle
+  (``queued → prefill → decode[i] → finished|evicted|shed``) and hapi
+  ``Model.fit`` opens a per-step span, so training and serving share
+  one timeline vocabulary; traces export as chrome-trace tracks or
+  JSON.
+- :mod:`.exporter` — strictly opt-in live endpoints:
+  :func:`start_telemetry_server` serves ``/metrics`` (Prometheus),
+  ``/varz`` (JSON snapshot + watchdog report), ``/healthz`` (shedding
+  state + drain estimate) and ``/traces``; :class:`ResourceSampler`
+  polls RSS / fds / GC / JAX live-buffer bytes into gauges.  Importing
+  paddle_tpu starts neither (tier-1 enforced).
 - the step-aware :class:`~paddle_tpu.profiler.Profiler` (re-exported
   here lazily to avoid an import cycle): ``make_scheduler`` windows,
   step-boundary instant events, and registry gauges emitted as
@@ -28,6 +41,11 @@ from .compile_watchdog import (  # noqa: F401
     watch,
     watchdog_enabled,
 )
+from .exporter import (  # noqa: F401
+    ResourceSampler,
+    TelemetryServer,
+    start_telemetry_server,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -35,12 +53,19 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     default_registry,
 )
+from .tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    default_tracer,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "CompileWatchdog", "default_watchdog", "watch",
     "enable_compile_watchdog", "disable_compile_watchdog",
     "watchdog_enabled",
+    "Span", "Tracer", "default_tracer",
+    "ResourceSampler", "TelemetryServer", "start_telemetry_server",
     # lazy (profiler leg)
     "Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
     "export_chrome_tracing",

@@ -1,0 +1,322 @@
+"""Live telemetry endpoints + resource sampler — the flight recorder's
+ops surface.
+
+Two strictly opt-in components (importing this module — or
+``paddle_tpu`` — starts no thread and opens no socket; a tier-1 test
+enforces that):
+
+- :func:`start_telemetry_server` — a stdlib ``http.server`` daemon
+  thread a fleet scheduler / Prometheus can scrape while the process
+  trains or serves:
+
+  ===========  ========================================================
+  ``/metrics``  Prometheus text exposition of the MetricsRegistry
+  ``/varz``     JSON registry snapshot + compile-watchdog report
+  ``/healthz``  serving health: healthy flag, queue depth, page
+                occupancy, and the engine's ``estimated_drain_s``
+                (HTTP 503 while shedding — load balancers eject on
+                status alone)
+  ``/traces``   recent completed traces from the Tracer (``?limit=N``)
+  ===========  ========================================================
+
+  ``port=0`` binds an ephemeral port (read it back from
+  ``server.port``) — tests and multi-process launches never fight over
+  a fixed port.
+
+- :class:`ResourceSampler` — a periodic daemon thread polling process
+  RSS, open-fd count, per-generation GC collections and JAX live-buffer
+  bytes into registry gauges (``process_rss_bytes`` & co.), so memory
+  leaks and fd leaks show up on ``/metrics`` long before the OOM
+  killer explains them post-mortem.  ``sample_once()`` works without
+  the thread (bench embeds one synchronous sample per section).
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .metrics import default_registry
+from .tracing import default_tracer
+
+__all__ = ["ResourceSampler", "TelemetryServer", "start_telemetry_server"]
+
+
+# --------------------------------------------------------------- sampler
+
+
+def _read_rss_bytes():
+    """Resident set size.  /proc is authoritative on Linux; the
+    getrusage fallback (peak, kilobytes) keeps macOS dev boxes working."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+def _count_open_fds():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def _jax_live_buffer_bytes():
+    """Bytes held by live jax arrays.  Only consulted when jax is
+    already imported — the sampler must not drag the accelerator
+    runtime in by itself."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return int(sum(int(x.nbytes) for x in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+class ResourceSampler:
+    """Poll process resources into registry gauges every ``interval_s``.
+
+    Opt-in: nothing happens until :meth:`start` (daemon thread) or
+    :meth:`sample_once` (synchronous).  Gauges — ``process_rss_bytes``,
+    ``process_open_fds``, ``python_gc_collections{gen=...}``,
+    ``jax_live_buffer_bytes`` — are registered lazily on the first
+    sample so constructing a sampler doesn't yet touch the registry.
+    """
+
+    def __init__(self, interval_s=5.0, registry=None):
+        self.interval_s = float(interval_s)
+        self.registry = registry or default_registry()
+        self._gauges = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._last = None
+
+    def _ensure_gauges(self):
+        if self._gauges is None:
+            reg = self.registry
+            self._gauges = {
+                "rss": reg.gauge("process_rss_bytes",
+                                 "resident set size of this process"),
+                "fds": reg.gauge("process_open_fds",
+                                 "open file descriptors"),
+                "gc": reg.gauge("python_gc_collections",
+                                "cumulative GC runs per generation",
+                                labelnames=("gen",)),
+                "jax": reg.gauge("jax_live_buffer_bytes",
+                                 "bytes held by live jax arrays"),
+            }
+        return self._gauges
+
+    def sample_once(self):
+        """Take one sample, update the gauges, return it as a dict
+        (``None`` fields = unavailable on this platform)."""
+        g = self._ensure_gauges()
+        rss = _read_rss_bytes()
+        fds = _count_open_fds()
+        jax_bytes = _jax_live_buffer_bytes()
+        gc_counts = {str(i): s.get("collections", 0)
+                     for i, s in enumerate(gc.get_stats())}
+        if rss is not None:
+            g["rss"].set(rss)
+        if fds is not None:
+            g["fds"].set(fds)
+        if jax_bytes is not None:
+            g["jax"].set(jax_bytes)
+        for gen, n in gc_counts.items():
+            g["gc"].labels(gen=gen).set(n)
+        self._last = {"rss_bytes": rss, "open_fds": fds,
+                      "gc_collections": gc_counts,
+                      "jax_live_buffer_bytes": jax_bytes}
+        return self._last
+
+    @property
+    def last_sample(self):
+        return self._last
+
+    # ---- thread ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="resource-sampler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.sample_once()
+            except Exception:
+                pass                        # sampling must never kill ops
+            self._stop.wait(self.interval_s)
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=5.0)
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+# ---------------------------------------------------------------- server
+
+
+class _TelemetryHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-telemetry"
+
+    def log_message(self, *args):           # keep scrapes off stderr
+        pass
+
+    def _send(self, code, body, ctype="application/json"):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype + "; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):                       # noqa: N802 (stdlib API)
+        srv = self.server
+        url = urlparse(self.path)
+        try:
+            if url.path == "/metrics":
+                self._send(200, srv.registry.expose_prometheus(),
+                           ctype="text/plain; version=0.0.4")
+            elif url.path == "/varz":
+                self._send(200, json.dumps(srv.varz()))
+            elif url.path == "/healthz":
+                health = srv.healthz()
+                code = 200 if health.get("healthy", True) else 503
+                self._send(code, json.dumps(health))
+            elif url.path == "/traces":
+                q = parse_qs(url.query)
+                limit = int(q["limit"][0]) if "limit" in q else None
+                self._send(200, json.dumps(
+                    {"traces": srv.tracer.traces(limit=limit)}))
+            else:
+                self._send(404, json.dumps({"error": "not found",
+                                            "path": url.path}))
+        except Exception as e:              # a broken page must not wedge
+            self._send(500, json.dumps({"error": repr(e)}))
+
+
+class TelemetryServer(ThreadingHTTPServer):
+    """The bound-and-running telemetry endpoint set.
+
+    Constructed by :func:`start_telemetry_server`; ``port`` is the bound
+    port (meaningful with ``port=0``), ``url`` a convenience base, and
+    ``stop()`` shuts the daemon thread down.  Works as a context
+    manager."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, registry, tracer, engine, watchdog):
+        super().__init__(addr, _TelemetryHandler)
+        self.registry = registry
+        self.tracer = tracer
+        self.engine = engine
+        self.watchdog = watchdog
+        self._serve_thread = None
+
+    # ---- payload builders ----------------------------------------------
+    def varz(self):
+        wd = self.watchdog
+        if wd is None:
+            from .compile_watchdog import default_watchdog
+
+            wd = default_watchdog()
+        return {"pid": os.getpid(),
+                "metrics": self.registry.snapshot(),
+                "jit": wd.report()}
+
+    def healthz(self):
+        """Live serving health.  With an engine attached its
+        ``health()`` is authoritative; otherwise fall back to the
+        serving gauges in the registry (a scraper still gets the
+        shedding flag + drain estimate published by ``Engine.step``)."""
+        if self.engine is not None:
+            return self.engine.health()
+
+        def gauge_value(name):
+            m = self.registry.get(name)
+            return m.value if m is not None and m.kind == "gauge" else None
+
+        healthy = gauge_value("serving_engine_healthy")
+        return {"healthy": bool(healthy) if healthy is not None else True,
+                "queue_depth": gauge_value("serving_queue_depth"),
+                "page_occupancy": gauge_value("serving_page_occupancy"),
+                "estimated_drain_s":
+                    gauge_value("serving_estimated_drain_s")}
+
+    # ---- lifecycle ------------------------------------------------------
+    @property
+    def port(self):
+        return self.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://{self.server_address[0]}:{self.port}"
+
+    def _start(self):
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="telemetry-server",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def stop(self):
+        t, self._serve_thread = self._serve_thread, None
+        if t is not None:
+            self.shutdown()
+            t.join(timeout=5.0)
+        self.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
+                           tracer=None, engine=None, watchdog=None):
+    """Bind and start the telemetry endpoints on a daemon thread.
+
+    ``port=0`` picks an ephemeral port (``server.port`` tells you which).
+    ``engine`` (a ``serving.Engine``) makes ``/healthz`` live — queue
+    depth, occupancy and ``estimated_drain_s`` straight from the
+    scheduler; without it the serving gauges in ``registry`` are used.
+    ``tracer`` defaults to the engine's tracer when one is attached,
+    else the process-wide :func:`default_tracer`.  Never called on
+    import anywhere in the framework — telemetry is strictly opt-in.
+    """
+    if tracer is None:
+        tracer = (engine.tracer if engine is not None
+                  and getattr(engine, "tracer", None) is not None
+                  else default_tracer())
+    srv = TelemetryServer((host, int(port)),
+                          registry or default_registry(), tracer,
+                          engine, watchdog)
+    return srv._start()

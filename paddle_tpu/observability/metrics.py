@@ -194,13 +194,15 @@ class Histogram(_Metric):
     @staticmethod
     def _pct(sorted_samples, p):
         if not sorted_samples:
-            return 0.0
+            return None
         n = len(sorted_samples)
         idx = min(n - 1, max(0, math.ceil(p / 100.0 * n) - 1))
         return sorted_samples[idx]
 
     def percentile(self, p):
-        """Exact percentile over the reservoir (p in 0..100)."""
+        """Exact percentile over the reservoir (p in 0..100); ``None``
+        on an empty series — a fresh process's exporter scrape must not
+        raise, and 0.0 would read as "instant", not "no data"."""
         with self._lock:
             s = sorted(self._samples)
         return self._pct(s, p)
@@ -208,12 +210,13 @@ class Histogram(_Metric):
     def summary(self):
         """count/mean/p50/p95/p99 — ONE reservoir sort per call (not one
         per percentile) and one lock hold, so it is also a consistent
-        point-in-time read against concurrent ``observe``."""
+        point-in-time read against concurrent ``observe``.  An empty
+        series yields ``count=0`` with None-filled stats (JSON null)."""
         with self._lock:
             s = sorted(self._samples)
             total, total_sum = self.total, self.sum
         return {"count": total,
-                "mean": total_sum / total if total else 0.0,
+                "mean": total_sum / total if total else None,
                 "p50": self._pct(s, 50), "p95": self._pct(s, 95),
                 "p99": self._pct(s, 99)}
 

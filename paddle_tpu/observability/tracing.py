@@ -1,0 +1,269 @@
+"""Request-level tracing — the flight recorder's span model.
+
+Metrics (histograms, counters) answer "how slow is the p95"; they cannot
+answer "why was *this* request slow".  A :class:`Tracer` records one
+bounded-memory timeline per logical operation — a serving request's full
+lifecycle (``queued → admitted → prefill → decode[i] →
+finished|evicted|shed``), a training step — as a tree of :class:`Span`\\ s
+sharing a ``trace_id``.  Design points:
+
+- **thread-safe, bounded**: spans mutate under the tracer's lock; a
+  completed trace (its root span ended) moves into a ring buffer of the
+  newest ``max_traces`` traces, so a serving process that handles
+  millions of requests holds a constant-size flight record.
+- **injectable clock**: the tracer reads time from a ``clock`` callable
+  (seconds, ``time.perf_counter`` by default) — the serving engine hands
+  its own clock over, so deadline tests drive spans deterministically
+  and span timestamps share the engine's timebase.
+- **chrome-trace export**: :meth:`Tracer.export_chrome` renders every
+  completed trace as one track (``tid`` = trace id, labelled with the
+  root span's name) of nested ``"X"`` events via the profiler's
+  exporter — the same perf_counter timebase as ``ProfilerStep#N``
+  instants, so request timelines and profiler step marks correlate in
+  one Perfetto view.
+- **JSON export**: :meth:`Tracer.traces` returns completed traces as
+  JSON-able dicts — the telemetry server's ``/traces`` payload and the
+  bench's embedded trace summary.
+
+Nothing here starts threads or opens sockets; the process-wide
+:func:`default_tracer` is a plain object created at import.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+__all__ = ["Span", "Tracer", "default_tracer", "traces_to_chrome_events"]
+
+
+class Span:
+    """One timed operation inside a trace.
+
+    Created via :meth:`Tracer.start_trace` (root) or
+    :meth:`Tracer.start_span` (child); ``end()`` stamps the end time and,
+    for a root span, finalizes the whole trace into the tracer's ring
+    buffer.  Usable as a context manager.  ``attributes`` is a JSON-able
+    dict (page-pool occupancy, batch slot, epoch/step, ...).
+    """
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_s",
+                 "end_s", "attributes", "_tracer")
+
+    def __init__(self, name, trace_id, span_id, parent_id, start_s,
+                 tracer, attributes=None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_s = start_s
+        self.end_s = None
+        self.attributes = dict(attributes or {})
+        self._tracer = tracer
+
+    @property
+    def is_root(self):
+        return self.parent_id is None
+
+    @property
+    def ended(self):
+        return self.end_s is not None
+
+    def set_attribute(self, key, value):
+        self.attributes[key] = value
+        return self
+
+    def set_attributes(self, mapping):
+        self.attributes.update(mapping)
+        return self
+
+    def end(self, end_s=None):
+        self._tracer._end_span(self, end_s)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.attributes.setdefault("error", repr(exc))
+        self.end()
+        return False
+
+    def to_dict(self):
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start_s": self.start_s, "end_s": self.end_s,
+                "attributes": dict(self.attributes)}
+
+    def __repr__(self):
+        state = "ended" if self.ended else "open"
+        return (f"Span({self.name!r}, trace={self.trace_id}, "
+                f"span={self.span_id}, {state})")
+
+
+class Tracer:
+    """Span factory + bounded ring buffer of completed traces.
+
+    ``clock`` is a zero-arg callable returning seconds (defaults to
+    ``time.perf_counter`` — the profiler's timebase); ``max_traces``
+    bounds the completed-trace ring.  A trace completes when its root
+    span ends; any still-open child is force-ended at the root's end
+    time with ``attributes["unfinished"] = True`` (a crash-truncated
+    request still yields a readable timeline).
+    """
+
+    def __init__(self, clock=None, max_traces=256):
+        self.clock = clock or time.perf_counter
+        self.max_traces = int(max_traces)
+        self._lock = threading.Lock()
+        self._next_trace_id = 1
+        self._next_span_id = 1
+        self._live = {}            # trace_id -> [Span, ...] (root first)
+        self._completed = []       # ring of trace dicts, oldest first
+        self._n_completed = 0      # lifetime count (ring evicts)
+
+    # ---- span lifecycle -------------------------------------------------
+    def start_trace(self, name, attributes=None, start_s=None):
+        """Open a new trace; returns its root span."""
+        with self._lock:
+            tid = self._next_trace_id
+            self._next_trace_id += 1
+            sid = self._next_span_id
+            self._next_span_id += 1
+            span = Span(name, tid, sid, None,
+                        self.clock() if start_s is None else start_s,
+                        self, attributes)
+            self._live[tid] = [span]
+        return span
+
+    def start_span(self, name, parent, attributes=None, start_s=None):
+        """Open a child span under ``parent`` (a Span of this tracer)."""
+        with self._lock:
+            sid = self._next_span_id
+            self._next_span_id += 1
+            span = Span(name, parent.trace_id, sid, parent.span_id,
+                        self.clock() if start_s is None else start_s,
+                        self, attributes)
+            spans = self._live.get(parent.trace_id)
+            if spans is not None:
+                spans.append(span)
+        return span
+
+    @contextlib.contextmanager
+    def trace(self, name, attributes=None):
+        """``with tracer.trace("hapi::step", {...}) as span:`` — a whole
+        root-span trace scoped to the block."""
+        span = self.start_trace(name, attributes)
+        try:
+            yield span
+        except BaseException as e:
+            span.attributes.setdefault("error", repr(e))
+            raise
+        finally:
+            span.end()
+
+    @contextlib.contextmanager
+    def span(self, name, parent, attributes=None):
+        """Child-span context manager."""
+        span = self.start_span(name, parent, attributes)
+        try:
+            yield span
+        finally:
+            span.end()
+
+    def _end_span(self, span, end_s=None):
+        with self._lock:
+            if span.ended:
+                return
+            span.end_s = self.clock() if end_s is None else end_s
+            if not span.is_root:
+                return
+            spans = self._live.pop(span.trace_id, None)
+            if spans is None:
+                return
+            for s in spans:
+                if not s.ended:                 # truncated child
+                    s.end_s = span.end_s
+                    s.attributes["unfinished"] = True
+            self._completed.append({
+                "trace_id": span.trace_id, "name": span.name,
+                "start_s": span.start_s, "end_s": span.end_s,
+                "duration_s": span.end_s - span.start_s,
+                "spans": [s.to_dict() for s in spans],
+            })
+            self._n_completed += 1
+            if len(self._completed) > self.max_traces:
+                del self._completed[:len(self._completed) -
+                                    self.max_traces]
+
+    # ---- readers --------------------------------------------------------
+    def traces(self, limit=None):
+        """Completed traces (oldest → newest), each a JSON-able dict;
+        ``limit`` keeps only the newest N."""
+        with self._lock:
+            out = list(self._completed)
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def summary(self):
+        """Aggregate over the ring: lifetime completed count plus
+        per-root-name count/total duration — the bench's embedded
+        trace digest."""
+        by_name = {}
+        for tr in self.traces():
+            # request#N / decode[i] collapse to one aggregate key each
+            key = tr["name"].split("#")[0].split("[")[0]
+            cnt, tot = by_name.get(key, (0, 0.0))
+            by_name[key] = (cnt + 1, tot + tr["duration_s"])
+        return {"completed": self._n_completed,
+                "buffered": len(self.traces()),
+                "by_name": {k: {"count": c, "total_s": t}
+                            for k, (c, t) in sorted(by_name.items())}}
+
+    def reset(self):
+        with self._lock:
+            self._live.clear()
+            self._completed.clear()
+            self._n_completed = 0
+
+    # ---- chrome export --------------------------------------------------
+    def export_chrome(self, path, extra_events=()):
+        """Write completed traces as chrome-trace JSON, one labelled
+        track per trace.  ``extra_events`` (profiler recorder tuples,
+        e.g. a drained Profiler's ``_events``) are merged in, so request
+        tracks and ``ProfilerStep#N`` instants share the file."""
+        from ..profiler.profiler import export_events_chrome
+
+        events, names = traces_to_chrome_events(self.traces())
+        export_events_chrome(list(extra_events) + events, path,
+                             thread_names=names)
+        return path
+
+
+def traces_to_chrome_events(traces):
+    """Lower trace dicts to profiler recorder tuples.
+
+    Returns ``(events, thread_names)``: ``("X", name, start_ns, end_ns,
+    tid)`` spans with ``tid`` = trace id (one track per trace) and a
+    ``{tid: label}`` map naming each track after its root span."""
+    events, names = [], {}
+    for tr in traces:
+        tid = tr["trace_id"]
+        names[tid] = tr["name"]
+        for s in tr["spans"]:
+            end_s = s["end_s"] if s["end_s"] is not None else s["start_s"]
+            events.append(("X", s["name"], int(s["start_s"] * 1e9),
+                           int(end_s * 1e9), tid))
+    return events, names
+
+
+_DEFAULT = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer: hapi fit steps and default-clock serving
+    engines record here, and the telemetry server's ``/traces`` serves
+    it (mirrors ``metrics.default_registry``)."""
+    return _DEFAULT

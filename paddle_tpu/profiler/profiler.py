@@ -313,11 +313,16 @@ class Profiler:
         return self._device_dir
 
 
-def export_events_chrome(events, path):
+def export_events_chrome(events, path, thread_names=None):
     """Chrome-trace JSON: "X" spans, "i" step instants, "C" counter
     tracks, plus process_name/thread_name metadata ("M") so Perfetto
-    labels the tracks instead of showing raw pids/tids."""
+    labels the tracks instead of showing raw pids/tids.
+
+    ``thread_names`` ({tid: label}) overrides the default "host thread
+    N" track labels — the tracing flight recorder uses one track per
+    request (tid = trace id) labelled "request#N"."""
     pid = os.getpid()
+    thread_names = thread_names or {}
     trace = {"traceEvents": [{
         "name": "process_name", "ph": "M", "pid": pid,
         "args": {"name": f"paddle_tpu host (pid {pid})"},
@@ -349,7 +354,8 @@ def export_events_chrome(events, path):
     for tid in sorted(tids):
         trace["traceEvents"].append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-            "args": {"name": f"host thread {tid}"},
+            "args": {"name": thread_names.get(tid,
+                                              f"host thread {tid}")},
         })
     d = os.path.dirname(os.path.abspath(path))
     if d:

@@ -16,6 +16,17 @@ armed, feasible-but-unlucky ones get the soft RETRY_AFTER; requests
 with a TTL are EVICTED (pages freed, partial output kept) the moment
 a step starts past their deadline; the ``serving_engine_healthy``
 gauge tells ops which regime the engine is in.
+
+Drain-estimate contract: every RETRY_AFTER request carries
+``Request.retry_after_s`` — a finite, strictly positive number of
+seconds derived from the live backlog (queued + running decode tokens
+still owed) divided by the engine's EWMA decode rate
+(``Engine.estimated_drain_s()``).  The same figure is published as the
+``serving_estimated_drain_s`` gauge and on the telemetry server's
+``/healthz`` (README "Flight recorder"), so front-ends and fleet
+schedulers back off by measured drain time, not a guessed constant.
+Every request is additionally traced queued→prefill→decode[i]→terminal
+through ``Engine.tracer`` (chrome-trace / JSON exportable).
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
 from .kv_cache import PagedKVCache  # noqa: F401

@@ -39,6 +39,20 @@ Overload robustness (the production-traffic contract):
   past its deadline — mid-decode or still queued — freeing its pages
   for requests that can still meet theirs.  A request nobody is
   waiting for anymore is pure waste to keep decoding.
+- **retry-after hint** — a shed request carries ``retry_after_s``:
+  the engine's ``estimated_drain_s`` (outstanding decode tokens ÷ the
+  EWMA decode rate), so a cooperating front-end backs off for exactly
+  as long as the backlog needs instead of hammering a bare
+  RETRY_AFTER.  The same figure is published on ``/healthz`` and the
+  ``serving_estimated_drain_s`` gauge.
+
+Flight recorder: every request is traced — a root span per request
+(one chrome-trace track), with ``queued`` / ``prefill`` /
+``decode[i]`` child spans carrying batch-slot and page-pool-occupancy
+attributes, through terminal states finished / evicted / shed.  The
+engine shares the process-wide tracer by default; with an injected
+``clock`` it gets a private Tracer on that clock so tests drive span
+timestamps deterministically.
 
 Sampling is host-side (greedy / temperature / top-k / top-p) with a
 per-request numpy Generator seeded at submit, so outputs are
@@ -57,6 +71,7 @@ import jax.numpy as jnp
 
 from ..models.gpt import GPTConfig, gpt_decode_step, gpt_init, gpt_prefill
 from ..observability.compile_watchdog import watch
+from ..observability.tracing import Tracer, default_tracer
 from ..profiler.profiler import RecordEvent
 from .kv_cache import PagedKVCache
 from .metrics import ServingMetrics
@@ -102,7 +117,10 @@ class Request:
     t_first_token: float = None
     t_finished: float = None
     deadline: float = None     # absolute engine-clock time, None = no TTL
+    retry_after_s: float = None  # drain-estimate hint on RETRY_AFTER
     _rng: object = None
+    _span: object = None       # root trace span (one per request)
+    _phase: object = None      # current lifecycle child span
 
     @property
     def output(self):
@@ -130,16 +148,31 @@ class Engine:
     fraction, 0..1) and ``shed_queue_high/low`` (queue depth) arm
     watermark load shedding; lows default to 3/4 of their high.
     ``clock`` replaces time.perf_counter (tests drive a manual clock so
-    deadline behavior is deterministic, not sleep-based).
+    deadline behavior is deterministic, not sleep-based).  ``tracer``
+    overrides the flight recorder; by default the engine records into
+    the process-wide tracer, or — when a custom ``clock`` is injected —
+    into a private Tracer on that clock (so manual-clock tests get
+    deterministic span timestamps without touching global state).
     """
+
+    #: assumed decode throughput (tok/s) until the first decode step has
+    #: measured the real EWMA rate — only ever used for the drain
+    #: estimate of a request shed before any decoding happened
+    ASSUMED_DECODE_RATE = 100.0
 
     def __init__(self, cfg: GPTConfig, params=None, *, page_size=16,
                  num_pages=256, max_batch_size=4, prefill_len=None,
                  default_ttl_s=None, shed_occupancy_high=None,
                  shed_occupancy_low=None, shed_queue_high=None,
-                 shed_queue_low=None, clock=None):
+                 shed_queue_low=None, clock=None, tracer=None):
         self.cfg = cfg
         self._clock = clock or time.perf_counter
+        if tracer is None:
+            tracer = (default_tracer() if clock is None
+                      else Tracer(clock=self._clock))
+        self.tracer = tracer
+        self._decode_rate_ewma = None     # tok/s, None until first decode
+        self._ewma_alpha = 0.25
         self.default_ttl_s = default_ttl_s
         self.shed_occupancy_high = shed_occupancy_high
         self.shed_occupancy_low = (
@@ -192,7 +225,9 @@ class Engine:
     # ------------------------------------------------------------- submit
     def add_request(self, prompt, sampling: SamplingParams = None):
         """Queue a prompt (list of token ids).  Returns the Request;
-        state is REJECTED immediately when it can never be served."""
+        state is REJECTED immediately when it can never be served, and
+        a shed request carries ``retry_after_s`` (the live drain
+        estimate) next to its RETRY_AFTER state."""
         sampling = sampling or SamplingParams()
         req = Request(id=self._next_id, prompt=list(prompt),
                       sampling=sampling, t_submit=self._clock())
@@ -204,6 +239,11 @@ class Engine:
         if ttl is not None:
             req.deadline = req.t_submit + float(ttl)
         self.metrics.requests_submitted.inc()
+        req._span = self.tracer.start_trace(
+            f"request#{req.id}", start_s=req.t_submit,
+            attributes={"request_id": req.id,
+                        "prompt_len": len(req.prompt),
+                        "max_new_tokens": sampling.max_new_tokens})
 
         total = len(req.prompt) + sampling.max_new_tokens
         reason = None
@@ -223,19 +263,82 @@ class Engine:
             req.state = RequestState.REJECTED
             req.finish_reason = reason
             self.metrics.requests_rejected.inc()
+            self._end_trace(req)
             return req
         if self._update_shedding():
             # soft rejection: the request IS feasible, the engine is
-            # just saturated — a client should back off and resubmit
+            # just saturated — back off ~retry_after_s and resubmit
             req.state = RequestState.RETRY_AFTER
+            req.retry_after_s = self._retry_after()
             req.finish_reason = (
                 f"load shed: occupancy {self.cache.occupancy():.2f}, "
-                f"queue depth {len(self._queue)} — retry later")
+                f"queue depth {len(self._queue)} — retry in "
+                f"{req.retry_after_s:.3f}s")
             self.metrics.requests_shed.inc()
+            self.metrics.estimated_drain_s.set(req.retry_after_s)
+            self._end_trace(req)
             return req
         self._queue.append(req)
+        req._phase = self.tracer.start_span("queued", req._span,
+                                            start_s=req.t_submit)
+        self.metrics.queue_depth.set(len(self._queue))
         self._update_shedding()
         return req
+
+    # ----------------------------------------------------- flight recorder
+    def _end_phase(self, req, end_s=None, **attrs):
+        if req._phase is not None:
+            req._phase.set_attributes(attrs)
+            req._phase.end(end_s)
+            req._phase = None
+
+    def _end_trace(self, req, end_s=None):
+        """Terminal span bookkeeping: close the open phase (if any) and
+        the request root, stamping the final state / reason / output
+        size and the pool occupancy at that instant."""
+        if req._span is None:
+            return
+        self._end_phase(req, end_s)
+        req._span.set_attributes({
+            "state": req.state, "finish_reason": req.finish_reason,
+            "tokens_out": len(req.output),
+            "page_occupancy": round(self.cache.occupancy(), 4)})
+        if req.retry_after_s is not None:
+            req._span.set_attribute("retry_after_s", req.retry_after_s)
+        req._span.end(end_s)
+
+    # ------------------------------------------------------ drain estimate
+    def pending_decode_tokens(self):
+        """Decode tokens still owed to queued + running requests (the
+        backlog the drain estimate is over)."""
+        owed = sum(r.sampling.max_new_tokens - len(r.output)
+                   for r in self._queue)
+        owed += sum(max(0, r.sampling.max_new_tokens - len(r.output))
+                    for r in self._running())
+        return owed
+
+    def decode_rate(self):
+        """EWMA decode throughput in tok/s (None before the first
+        decode step)."""
+        return self._decode_rate_ewma
+
+    def estimated_drain_s(self):
+        """Seconds to decode the current backlog at the measured rate —
+        the machine-readable retry-after hint (ROADMAP: "estimated
+        drain time from queue depth × decode rate").  Falls back to
+        ASSUMED_DECODE_RATE before the first decode measurement."""
+        tokens = self.pending_decode_tokens()
+        if tokens <= 0:
+            return 0.0
+        rate = self._decode_rate_ewma or self.ASSUMED_DECODE_RATE
+        return tokens / max(rate, 1e-9)
+
+    def _retry_after(self):
+        """Finite, strictly positive back-off for a shed request: at
+        least one decode-step's worth even when the backlog estimate
+        rounds to zero."""
+        rate = self._decode_rate_ewma or self.ASSUMED_DECODE_RATE
+        return max(self.estimated_drain_s(), 1.0 / max(rate, 1e-9))
 
     # ----------------------------------------------------- load shedding
     def _update_shedding(self):
@@ -268,6 +371,7 @@ class Engine:
         req.finish_reason = "deadline"
         req.t_finished = now
         self.metrics.deadline_evictions.inc()
+        self._end_trace(req, end_s=now)
         self._just_finished.append(req)
 
     def _evict_expired(self):
@@ -309,10 +413,19 @@ class Engine:
             self._slots[slot] = req
             self.metrics.requests_admitted.inc()
             self.metrics.queue_wait.observe(now - req.t_submit)
+            self._end_phase(req, end_s=now)      # queued → admitted
+            if req._span is not None:
+                req._span.set_attributes({
+                    "batch_slot": slot,
+                    "occupancy_at_admit":
+                        round(self.cache.occupancy(), 4)})
             self._prefill(req)
 
     def _prefill(self, req):
         n = len(req.prompt)
+        req._phase = self.tracer.start_span(
+            "prefill", req._span, attributes={"prompt_len": n}) \
+            if req._span is not None else None
         toks = np.zeros((1, self.prefill_len), np.int32)
         toks[0, :n] = req.prompt
         tables = np.asarray([self.cache.page_table(req.id)], np.int32)
@@ -329,6 +442,7 @@ class Engine:
         req.t_first_token = self._clock()
         self.metrics.ttft.observe(req.t_first_token - req.t_submit)
         self.metrics.tokens_generated.inc()
+        self._end_phase(req, end_s=req.t_first_token)  # prefill done
         self._maybe_finish(req)
 
     # -------------------------------------------------------------- decode
@@ -343,6 +457,13 @@ class Engine:
         req._reset_for_recompute()
         self._queue.appendleft(req)
         self.metrics.requests_preempted.inc()
+        # lifecycle rewinds with the tokens: close the open phase and
+        # re-enter "queued" so the trace shows the preemption gap
+        self._end_phase(req, preempted=True)
+        if req._span is not None:
+            req._span.attributes["preemptions"] = \
+                req._span.attributes.get("preemptions", 0) + 1
+            req._phase = self.tracer.start_span("queued", req._span)
 
     def _ensure_capacity(self):
         """Every running sequence needs a page slot for the token decode
@@ -381,8 +502,17 @@ class Engine:
                 jnp.asarray(seq_lens), jnp.asarray(tables))
             logits = np.asarray(logits)
         self.cache.k_pages, self.cache.v_pages = k, v
-        dt = self._clock() - t0
+        t1 = self._clock()
+        dt = t1 - t0
         n_active = len(running)
+        if dt > 0:
+            # EWMA decode throughput feeds the drain/retry-after hint
+            inst = n_active / dt
+            a = self._ewma_alpha
+            self._decode_rate_ewma = (
+                inst if self._decode_rate_ewma is None
+                else a * inst + (1 - a) * self._decode_rate_ewma)
+        occ = round(self.cache.occupancy(), 4)
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -392,6 +522,15 @@ class Engine:
                 req.t_first_token = self._clock()
             self.metrics.tokens_generated.inc()
             self.metrics.decode_token.observe(dt / n_active)
+            if req._span is not None:
+                # retroactive span over the batched step this request
+                # rode in — one decode[i] per generated token
+                self.tracer.start_span(
+                    f"decode[{len(req.output) - 1}]", req._span,
+                    start_s=t0, attributes={"batch_slot": i,
+                                            "batch_size": n_active,
+                                            "page_occupancy": occ},
+                ).end(t1)
             self._maybe_finish(req)
 
     # ------------------------------------------------------------ sampling
@@ -436,6 +575,7 @@ class Engine:
         if req in self._slots:
             self._slots[self._slots.index(req)] = None
         self.metrics.requests_finished.inc()
+        self._end_trace(req, end_s=req.t_finished)
         self._just_finished.append(req)
 
     # --------------------------------------------------------------- drive
@@ -451,8 +591,21 @@ class Engine:
         self._decode_once()
         self._update_shedding()
         self.metrics.page_occupancy.set(self.cache.occupancy())
+        self.metrics.queue_depth.set(len(self._queue))
+        self.metrics.estimated_drain_s.set(self.estimated_drain_s())
         done, self._just_finished = self._just_finished, []
         return done
+
+    def health(self):
+        """Live scheduler health — the ``/healthz`` payload: shedding
+        flag, queue depth, in-flight batch, pool occupancy, and the
+        drain estimate a cooperating front-end should back off by."""
+        return {"healthy": not self._shedding,
+                "queue_depth": len(self._queue),
+                "running": len(self._running()),
+                "page_occupancy": self.cache.occupancy(),
+                "estimated_drain_s": self.estimated_drain_s(),
+                "decode_rate_tok_s": self._decode_rate_ewma}
 
     def generate(self, prompts, sampling=None):
         """Batch convenience: submit all prompts, drive the scheduler to

@@ -44,11 +44,18 @@ class ServingMetrics:
         def add(metric):
             return reg.register(metric, replace=True)
 
-        self.requests_submitted = add(Counter("serving_requests_submitted"))
-        self.requests_admitted = add(Counter("serving_requests_admitted"))
-        self.requests_finished = add(Counter("serving_requests_finished"))
-        self.requests_rejected = add(Counter("serving_requests_rejected"))
-        self.requests_preempted = add(Counter("serving_requests_preempted"))
+        # counter names carry the Prometheus _total suffix —
+        # tools/check_metric_names.py (tier-1) enforces the convention
+        self.requests_submitted = add(Counter(
+            "serving_requests_submitted_total"))
+        self.requests_admitted = add(Counter(
+            "serving_requests_admitted_total"))
+        self.requests_finished = add(Counter(
+            "serving_requests_finished_total"))
+        self.requests_rejected = add(Counter(
+            "serving_requests_rejected_total"))
+        self.requests_preempted = add(Counter(
+            "serving_requests_preempted_total"))
         self.requests_shed = add(Counter(
             "serving_requests_shed_total",
             help="requests refused with RETRY_AFTER by watermark "
@@ -61,12 +68,20 @@ class ServingMetrics:
             "serving_engine_healthy",
             help="1 = healthy (admitting), 0 = degraded (shedding)"))
         self.engine_healthy.set(1)
-        self.prefill_tokens = add(Counter("serving_prefill_tokens"))
-        self.tokens_generated = add(Counter("serving_tokens_generated"))
+        self.prefill_tokens = add(Counter("serving_prefill_tokens_total"))
+        self.tokens_generated = add(Counter(
+            "serving_tokens_generated_total"))
         self.queue_wait = add(Histogram("serving_queue_wait_s"))
         self.ttft = add(Histogram("serving_ttft_s"))
         self.decode_token = add(Histogram("serving_decode_token_s"))
         self.page_occupancy = add(Gauge("serving_page_occupancy"))
+        self.queue_depth = add(Gauge(
+            "serving_queue_depth",
+            help="requests waiting in the admission queue"))
+        self.estimated_drain_s = add(Gauge(
+            "serving_estimated_drain_s",
+            help="estimated seconds to drain all queued + running work "
+                 "at the EWMA decode rate — the RETRY_AFTER hint"))
 
     def snapshot(self):
         return {
@@ -89,6 +104,8 @@ class ServingMetrics:
             "decode_token_s": self.decode_token.summary(),
             "page_occupancy": {"current": self.page_occupancy.value,
                                "peak": self.page_occupancy.peak},
+            "queue_depth": self.queue_depth.value,
+            "estimated_drain_s": self.estimated_drain_s.value,
         }
 
     def summary(self):
@@ -98,11 +115,16 @@ class ServingMetrics:
             f"{k}={v}" for k, v in s["requests"].items())]
         lines.append(f"{'tokens':<16} prefill={s['tokens']['prefill']} "
                      f"generated={s['tokens']['generated']}")
+        def ms(v):
+            # empty histograms report None (fresh process, nothing
+            # observed) — render as a dash, not a crash
+            return f"{v * 1e3:8.2f}ms" if v is not None else "       -"
+
         for key in ("queue_wait_s", "ttft_s", "decode_token_s"):
             h = s[key]
             lines.append(
-                f"{key:<16} n={h['count']:<6} mean={h['mean']*1e3:8.2f}ms "
-                f"p50={h['p50']*1e3:8.2f}ms p95={h['p95']*1e3:8.2f}ms")
+                f"{key:<16} n={h['count']:<6} mean={ms(h['mean'])} "
+                f"p50={ms(h['p50'])} p95={ms(h['p95'])}")
         occ = s["page_occupancy"]
         lines.append(f"{'page_occupancy':<16} current={occ['current']:.2f} "
                      f"peak={occ['peak']:.2f}")

@@ -141,7 +141,8 @@ class TestMetricsRegistry:
         try:
             for _ in range(200):
                 s = h.summary()
-                assert s["count"] >= 0 and s["p99"] >= s["p50"]
+                # before the first observe lands, stats are None-filled
+                assert s["count"] == 0 or s["p99"] >= s["p50"]
                 h.percentile(95)
         except Exception as e:              # pragma: no cover
             errs.append(e)
@@ -403,12 +404,12 @@ class TestServingMetricsThinClient:
         m.requests_submitted.inc(2)
         m.ttft.observe(0.1)
         snap = default_registry().snapshot()
-        assert snap["serving_requests_submitted"]["value"] == 2
+        assert snap["serving_requests_submitted_total"]["value"] == 2
         assert snap["serving_ttft_s"]["value"]["count"] == 1
         # rebuild = reset: fresh series replace the old ones globally
         m2 = ServingMetrics()
         assert default_registry().snapshot()[
-            "serving_requests_submitted"]["value"] == 0
+            "serving_requests_submitted_total"]["value"] == 0
         assert m2.snapshot()["requests"]["submitted"] == 0
 
     def test_isolated_registry(self):
@@ -417,12 +418,14 @@ class TestServingMetricsThinClient:
         reg = MetricsRegistry()
         m = ServingMetrics(registry=reg)
         m.tokens_generated.inc(5)
-        assert reg.snapshot()["serving_tokens_generated"]["value"] == 5
+        assert reg.snapshot()[
+            "serving_tokens_generated_total"]["value"] == 5
         snap = m.snapshot()
         assert snap["tokens"]["generated"] == 5
         assert set(snap) == {"requests", "tokens", "queue_wait_s",
                              "ttft_s", "decode_token_s", "page_occupancy",
-                             "engine_healthy"}
+                             "engine_healthy", "queue_depth",
+                             "estimated_drain_s"}
 
 
 # ------------------------------------------------------------------- bench

@@ -456,6 +456,64 @@ class TestFitAutoResume:
                                 resume_from=str(tmp_path / "none"))
         assert len(hist) == 1
 
+    def test_resume_restores_lr_scheduler_state(self, tmp_path):
+        """A stateful LR scheduler (its own step counter) rides in the
+        checkpoint: the resumed run's per-step LR sequence continues the
+        uninterrupted run's exactly — not one notch off."""
+        from paddle_tpu.hapi.callbacks import LRScheduler as LRStepCB
+        from paddle_tpu.optimizer.lr import StepDecay
+
+        def sched_model(seed=3):
+            paddle.seed(seed)
+            net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                nn.Linear(16, 2))
+            model = Model(net)
+            # halve every 2 scheduler steps: any off-by-one in the
+            # restored counter shifts the whole remaining LR sequence
+            sched = StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+            opt = paddle.optimizer.Momentum(learning_rate=sched,
+                                            parameters=model.parameters())
+            model.prepare(opt, nn.CrossEntropyLoss())
+            return model, sched
+
+        class LRRecorder(Callback):
+            def __init__(self):
+                super().__init__()
+                self.lrs = []
+
+            def on_train_batch_end(self, step, logs=None):
+                # first in the callback list: records the LR this batch
+                # actually trained with, before the scheduler advances
+                self.lrs.append(self.model._optimizer.get_lr())
+
+        def run(model, sched, ckdir=None, resume=None):
+            rec = LRRecorder()
+            cbs = [rec, LRStepCB(by_step=True)]
+            if ckdir:
+                # scheduler steps BEFORE the checkpoint callback saves,
+                # so the saved counter matches "batches completed"
+                cbs.append(CheckpointCallback(ckdir, every_n_steps=1))
+            model.fit(_Toy(), batch_size=16, epochs=2, shuffle=False,
+                      verbose=0, callbacks=cbs, resume_from=resume)
+            return rec.lrs
+
+        ref_lrs = run(*sched_model())
+        assert len(ref_lrs) == 8
+        assert len(set(ref_lrs)) > 2         # the schedule actually moves
+
+        ckdir = str(tmp_path / "ck")
+        model_a, sched_a = sched_model()
+        with injected_faults(FaultSpec("hapi.train_step", "kill",
+                                       occurrence=6)):
+            with pytest.raises(SimulatedCrash):
+                run(model_a, sched_a, ckdir=ckdir)
+
+        # fresh scheduler (counter at 0) — restore must fast-forward it
+        model_b, sched_b = sched_model(seed=99)
+        lrs_b = run(model_b, sched_b, ckdir=ckdir, resume=ckdir)
+        assert sched_b.last_epoch == 8       # 6 before kill + 2 after
+        np.testing.assert_allclose(ref_lrs[6:], lrs_b, rtol=0, atol=0)
+
     def test_resume_restores_rng_streams(self, tmp_path):
         """The checkpoint carries the stateful RNG: a resumed run's draws
         continue the killed run's sequence, not a fresh seed's."""

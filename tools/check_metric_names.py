@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Lint: metric names registered under paddle_tpu/ must follow
+Prometheus naming conventions.
+
+A metrics surface is only useful if dashboards can rely on its shape:
+``rate()`` over something not named ``*_total`` is a silent lie, a
+camelCase name breaks every recording rule, and one name registered as
+a counter here and a gauge there poisons the whole series.  Statically
+scanned rules (literal first-argument names to ``Counter(`` /
+``Gauge(`` / ``Histogram(`` and ``registry.counter(`` & co.):
+
+- names are ``snake_case`` (``^[a-z][a-z0-9_]*$``);
+- counter names end in ``_total``;
+- a name never appears with two different metric kinds across the
+  codebase.
+
+Run directly (exit 1 on violations) or import ``check()`` — a tier-1
+test wires it into the suite like ``check_atomic_writes``, so a
+nonconforming metric fails CI, not a dashboard review.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# Counter("name"...) / Gauge( / Histogram(  — constructor form — and
+# <registry>.counter("name"...) / .gauge( / .histogram( — get-or-create
+# form.  Only literal names are checkable statically; a variable name
+# is skipped (there are none today — keep it that way).
+_METRIC_CALL = re.compile(
+    r"""(?:\b(?P<cls>Counter|Gauge|Histogram)
+         |\.(?P<meth>counter|gauge|histogram))
+        \s*\(\s*(?P<q>['"])(?P<name>[^'"]+)(?P=q)""", re.VERBOSE)
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def check(root=None):
+    """Return a list of 'path:line: problem' violations."""
+    if root is None:
+        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "paddle_tpu")
+    root = os.path.abspath(root)
+    violations = []
+    seen = {}                    # name -> (kind, "path:line")
+    for dirpath, _, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            rel = "paddle_tpu/" + \
+                os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as f:
+                # strip per-line comments but keep the scan whole-file:
+                # a call split across lines (Counter(\n  "name")) must
+                # still be seen, since \s* matches the newline
+                code = "\n".join(line.split("#", 1)[0]
+                                 for line in f.read().splitlines())
+            for m in _METRIC_CALL.finditer(code):
+                kind = (m.group("cls") or m.group("meth")).lower()
+                name = m.group("name")
+                lineno = code.count("\n", 0, m.start()) + 1
+                where = f"{rel}:{lineno}"
+                if not _SNAKE.match(name):
+                    violations.append(
+                        f"{where}: metric name {name!r} is not "
+                        "snake_case")
+                if kind == "counter" and not name.endswith("_total"):
+                    violations.append(
+                        f"{where}: counter {name!r} must end in "
+                        "'_total' (Prometheus convention)")
+                prev = seen.get(name)
+                if prev is not None and prev[0] != kind:
+                    violations.append(
+                        f"{where}: {name!r} registered as {kind} "
+                        f"but as {prev[0]} at {prev[1]} — one "
+                        "name, one type")
+                else:
+                    seen.setdefault(name, (kind, where))
+    return violations
+
+
+def main(argv=None):
+    violations = check(argv[0] if argv else None)
+    if violations:
+        print("metric naming violations "
+              "(Prometheus conventions, see tools/check_metric_names.py):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("check_metric_names: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
