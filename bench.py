@@ -45,13 +45,6 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-# bf16 peak TFLOPS by device kind (public spec sheets)
-PEAK_TFLOPS = {
-    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 459.0,
-    "TPU v5p": 459.0, "TPU v4": 275.0, "TPU v3": 123.0, "TPU v2": 45.0,
-    "cpu": 1.0,
-}
-
 A100_EFFECTIVE_TF = 312.0 * 0.45      # Megatron-class A100 utilisation
 NORTH_STAR_FRACTION = 0.5
 
@@ -77,13 +70,14 @@ LADDER_13B = [
 
 
 def device_peak_tflops():
-    import jax
+    # the per-device-kind peak table lives with the MFU estimator
+    # (observability.goodput.PEAK_FLOPS, PADDLE_TPU_PEAK_FLOPS env
+    # override) — bench and the training goodput monitor must agree on
+    # the denominator or their MFU numbers silently diverge
+    from paddle_tpu.observability.goodput import device_peak_flops
 
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAK_TFLOPS.items():
-        if k.lower() in kind.lower():
-            return v, kind
-    return 197.0, kind
+    flops, kind = device_peak_flops(default=197.0e12)
+    return flops / 1e12, kind
 
 
 def gpt_nparams(cfg):
@@ -163,6 +157,12 @@ def bench_gpt(name, steps, warmup, batch, seq, accum=4, remat="dots",
     peak_tf, kind = device_peak_tflops()
     mfu = tok_s * flops_per_token / (peak_tf * 1e12)
     target_mfu = (NORTH_STAR_FRACTION * A100_EFFECTIVE_TF) / peak_tf
+    # publish so the section's embedded registry snapshot (and a
+    # scraping operator) sees the same number the JSON reports
+    from paddle_tpu.observability import default_registry
+
+    default_registry().gauge(
+        "training_mfu", "model FLOPs utilisation vs device peak").set(mfu)
     log(f"[gpt] {tok_s:.0f} tokens/s/chip  mfu={mfu*100:.1f}%  "
         f"({kind}, target mfu {target_mfu*100:.1f}%)")
     return {
@@ -442,6 +442,11 @@ def _section_telemetry(out):
     trace_digest = default_tracer().summary()
     if trace_digest["completed"]:
         out["traces"] = trace_digest
+    from paddle_tpu.observability.goodput import last_report
+
+    goodput = last_report()
+    if goodput:
+        out["goodput"] = goodput
     return out
 
 

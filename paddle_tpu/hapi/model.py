@@ -54,6 +54,19 @@ class Model:
         self._jit_eval = None
         self._opt_state = None   # functional optimizer state (jit path)
         self._mesh = None        # dp mesh (prepare(device_mesh=...))
+        self._watch_grad_norm = False   # train_batch reports grad_norm
+        self._jit_step_gnorm = False    # arity the built step returns
+
+    def enable_grad_norm_logging(self):
+        """Make ``train_batch`` report the global gradient norm in its
+        results (``logs["grad_norm"]``) — the HealthMonitor's spike
+        signal.  Costs one extra reduction over the gradients, so it is
+        opt-in; enabling after the jitted step was built drops the
+        cache (one recompile on the next batch)."""
+        if not self._watch_grad_norm:
+            self._watch_grad_norm = True
+            self._jit_step = None
+        return self
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -93,14 +106,22 @@ class Model:
             return l, (out_arr, new_buffers)
 
         grad_fn = jax.value_and_grad(pure_loss, has_aux=True)
+        log_gnorm = self._watch_grad_norm
 
         def step(params, buffers, opt_state, x, y, lr):
             (loss, (out, new_buffers)), grads = grad_fn(
                 params, buffers, x, y)
             new_params, new_opt = opt.apply_gradients(
                 params, grads, opt_state, lr)
+            if log_gnorm:
+                gnorm = jnp.sqrt(sum(
+                    (jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads)),
+                    start=jnp.zeros((), jnp.float32)))
+                return new_params, new_opt, loss, out, new_buffers, gnorm
             return new_params, new_opt, loss, out, new_buffers
 
+        self._jit_step_gnorm = log_gnorm
         self._jit_step = watch(jax.jit(step), name="hapi::train_step")
         return self._jit_step
 
@@ -146,9 +167,14 @@ class Model:
                 self._opt_state = opt.init_state(params)
             step = self._build_jit_step()
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            gnorm = None
             with RecordEvent("hapi::train_step"):
-                new_params, self._opt_state, loss, out, new_buffers = step(
-                    params, buffers, self._opt_state, x, y, lr)
+                outs = step(params, buffers, self._opt_state, x, y, lr)
+            if self._jit_step_gnorm:
+                (new_params, self._opt_state, loss, out, new_buffers,
+                 gnorm) = outs
+            else:
+                new_params, self._opt_state, loss, out, new_buffers = outs
             named = dict(self.network.named_parameters())
             for k, v in new_params.items():
                 named[k].data = v
@@ -161,11 +187,21 @@ class Model:
             out_t = self.network(Tensor(x))
             loss_t = self._loss(out_t, Tensor(y))
             loss_t.backward()
+            gnorm = None
+            if self._watch_grad_norm:
+                sq = 0.0
+                for p in self.network.parameters():
+                    if p.grad is not None:
+                        g = np.asarray(p.grad.data, dtype=np.float64)
+                        sq += float((g * g).sum())
+                gnorm = sq ** 0.5
             opt.step()
             opt.clear_grad()
             loss = loss_t.data
             out = out_t.data
         results = self._update_metrics(out, y)
+        if gnorm is not None:
+            results["grad_norm"] = float(gnorm)
         return float(loss), results
 
     def eval_batch(self, inputs, labels):

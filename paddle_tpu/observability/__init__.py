@@ -26,6 +26,24 @@ platform/monitor.h grown into a production observability stack):
   state + drain estimate) and ``/traces``; :class:`ResourceSampler`
   polls RSS / fds / GC / JAX live-buffer bytes into gauges.  Importing
   paddle_tpu starts neither (tier-1 enforced).
+- :mod:`.goodput` — the training health monitor's accounting leg:
+  :class:`GoodputMonitor` partitions every ``Model.fit`` step into
+  data-wait / compile / checkpoint / eval / compute phases
+  (``training_step_breakdown_seconds{phase=...}``), publishes the
+  ``training_goodput_ratio`` and ``training_mfu`` gauges (HLO
+  cost-analysis FLOPs over step wall time and the per-device-kind
+  :data:`~paddle_tpu.observability.goodput.PEAK_FLOPS` table).
+- :mod:`.health` — :class:`HealthMonitor`: NaN/Inf loss, gradient-norm
+  spikes (rolling z-score), loss plateaus and step-time outliers, with
+  warn/gauge/raise actions, the ``training_healthy`` gauge,
+  ``training_anomalies_total{kind=...}`` and a flight-recorder span per
+  event.
+- :mod:`.aggregate` — cross-rank aggregation over the TCPStore:
+  every rank publishes its registry snapshot
+  (:class:`RankMetricsPublisher`), rank 0 merges with ``rank=`` labels,
+  ages out stale ranks, and computes the straggler skew gauge
+  (:class:`ClusterAggregator`); the telemetry server serves the merged
+  exposition fleet-wide.
 - the step-aware :class:`~paddle_tpu.profiler.Profiler` (re-exported
   here lazily to avoid an import cycle): ``make_scheduler`` windows,
   step-boundary instant events, and registry gauges emitted as
@@ -33,6 +51,10 @@ platform/monitor.h grown into a production observability stack):
 """
 from __future__ import annotations
 
+from .aggregate import (  # noqa: F401
+    ClusterAggregator,
+    RankMetricsPublisher,
+)
 from .compile_watchdog import (  # noqa: F401
     CompileWatchdog,
     default_watchdog,
@@ -45,6 +67,16 @@ from .exporter import (  # noqa: F401
     ResourceSampler,
     TelemetryServer,
     start_telemetry_server,
+)
+from .goodput import (  # noqa: F401
+    PEAK_FLOPS,
+    GoodputMonitor,
+    device_peak_flops,
+    mfu,
+)
+from .health import (  # noqa: F401
+    HealthMonitor,
+    TrainingHealthError,
 )
 from .metrics import (  # noqa: F401
     Counter,
@@ -66,6 +98,9 @@ __all__ = [
     "watchdog_enabled",
     "Span", "Tracer", "default_tracer",
     "ResourceSampler", "TelemetryServer", "start_telemetry_server",
+    "GoodputMonitor", "PEAK_FLOPS", "device_peak_flops", "mfu",
+    "HealthMonitor", "TrainingHealthError",
+    "RankMetricsPublisher", "ClusterAggregator",
     # lazy (profiler leg)
     "Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
     "export_chrome_tracing",

@@ -1,0 +1,308 @@
+"""Cross-rank metric aggregation — fleet-wide /metrics from one scrape.
+
+Multi-host training lives or dies on per-rank visibility: aggregate
+throughput hides exactly the thing you need to see (which rank is the
+straggler, which host's loader is starving).  This module rides the
+existing :class:`~paddle_tpu.distributed.store.TCPStore` rendezvous
+plane — no new service, no new port per rank:
+
+- :class:`RankMetricsPublisher` — every rank periodically serializes
+  its :class:`MetricsRegistry` snapshot (JSON, wall-clock stamped) into
+  the store under ``metrics/rank_<r>``.  One key per rank, overwritten
+  in place: the store holds the *latest* snapshot, not a history.
+- :class:`ClusterAggregator` — rank 0 (or an external operator process
+  with a store client) merges the per-rank snapshots: every series gets
+  a ``rank="<r>"`` label in the merged Prometheus exposition, ranks
+  whose snapshot is older than ``stale_after_s`` **age out of the merge
+  instead of poisoning it** (a killed rank's last snapshot must not be
+  scraped as live data forever), and the cross-rank straggler signal
+  ``training_step_time_skew_seconds`` (max − min of per-rank mean step
+  time, from each rank's ``training_step_seconds`` histogram) is
+  computed on every collect.
+- the PR-4 telemetry server serves the merged exposition: pass
+  ``aggregator=`` to ``start_telemetry_server`` on rank 0 and
+  Prometheus scrapes ONE endpoint for the whole fleet.
+
+Histograms travel as their snapshot summaries (count/mean/quantiles),
+so the merged exposition renders them as Prometheus *summary* series
+(``{quantile="0.5"}`` + ``_sum``/``_count``) rather than lossy
+re-bucketed histograms.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .metrics import _fmt_labels, _prom_line, _prom_name, default_registry
+
+__all__ = ["RankMetricsPublisher", "ClusterAggregator"]
+
+
+def _rank_key(prefix, rank):
+    return f"{prefix}/rank_{int(rank)}"
+
+
+class RankMetricsPublisher:
+    """Publish this rank's registry snapshot into the TCPStore.
+
+    ``publish()`` pushes one snapshot now; ``start(interval_s)`` runs a
+    daemon thread doing so periodically (strictly opt-in — constructing
+    a publisher touches nothing).  The payload carries a wall-clock
+    stamp the aggregator uses for staleness, so publisher and
+    aggregator clocks must be comparable (NTP-synced hosts; tests
+    inject clocks)."""
+
+    def __init__(self, store, rank, registry=None, key_prefix="metrics",
+                 clock=None):
+        self.store = store
+        self.rank = int(rank)
+        self.registry = registry or default_registry()
+        self.key = _rank_key(key_prefix, rank)
+        self._clock = clock or time.time
+        self._thread = None
+        self._stop = threading.Event()
+        self.published = 0
+
+    def publish(self):
+        payload = {"rank": self.rank, "time": self._clock(),
+                   "metrics": self.registry.snapshot()}
+        self.store.set(self.key, json.dumps(payload))
+        self.published += 1
+        return payload
+
+    # ---- thread ---------------------------------------------------------
+    def start(self, interval_s=5.0):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, args=(float(interval_s),),
+            name=f"metrics-publisher-{self.rank}", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self, interval_s):
+        while not self._stop.is_set():
+            try:
+                self.publish()
+            except Exception:
+                pass            # a flaky store must not kill training
+            self._stop.wait(interval_s)
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=5.0)
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def _scalar_of(value):
+    """Best scalar reading of one snapshot value (gauge dict → current,
+    histogram summary → mean, counter → itself)."""
+    if isinstance(value, dict):
+        for key in ("current", "mean", "p50"):
+            if value.get(key) is not None:
+                return float(value[key])
+        return None
+    return float(value) if value is not None else None
+
+
+class ClusterAggregator:
+    """Merge per-rank snapshots from the store (rank-0 side).
+
+    ``collect()`` is the one I/O step: it mgets every rank's key,
+    drops stale/missing ranks (recorded in ``self.stale_ranks`` /
+    ``self.missing_ranks``), recomputes the skew gauge and returns
+    ``{rank: payload}``.  ``expose_prometheus()`` /
+    ``merged_snapshot()`` render the newest collect for the exporter.
+    """
+
+    def __init__(self, store, world_size, stale_after_s=30.0,
+                 registry=None, key_prefix="metrics",
+                 skew_metric="training_step_seconds", clock=None):
+        self.store = store
+        self.world_size = int(world_size)
+        self.stale_after_s = float(stale_after_s)
+        # fleet-level gauges (skew, rank counts) land in this LOCAL
+        # registry — rank 0's own — so they also ride its next publish
+        self.registry = registry or default_registry()
+        self.key_prefix = key_prefix
+        self.skew_metric = skew_metric
+        self._clock = clock or time.time
+        self._lock = threading.Lock()   # TCPStore client: one user at a time
+        self.stale_ranks = []
+        self.missing_ranks = []
+        self.last_skew_s = None
+        self._last = {}
+
+    # ---- collection -----------------------------------------------------
+    def _fetch_raw(self):
+        keys = [_rank_key(self.key_prefix, r)
+                for r in range(self.world_size)]
+        if hasattr(self.store, "mget"):
+            return self.store.mget(keys, value_size_hint=1 << 16)
+        out = []
+        for k in keys:
+            try:
+                out.append(self.store.get(k, blocking=False))
+            except KeyError:
+                out.append(None)
+        return out
+
+    def collect(self):
+        """Fetch + filter every rank's latest snapshot; returns
+        ``{rank: payload}`` of the fresh ones."""
+        with self._lock:
+            raw = self._fetch_raw()
+        now = self._clock()
+        fresh, stale, missing = {}, [], []
+        for rank, blob in enumerate(raw):
+            if blob is None:
+                missing.append(rank)
+                continue
+            try:
+                payload = json.loads(blob)
+            except ValueError:
+                stale.append(rank)
+                continue
+            if now - payload.get("time", 0.0) > self.stale_after_s:
+                stale.append(rank)
+                continue
+            fresh[rank] = payload
+        self.stale_ranks, self.missing_ranks = stale, missing
+        self._last = fresh
+        self._update_fleet_gauges(fresh)
+        return fresh
+
+    def _rank_step_means(self, fresh):
+        out = {}
+        for rank, payload in fresh.items():
+            entry = payload.get("metrics", {}).get(self.skew_metric)
+            if not entry or "value" not in entry:
+                continue
+            v = _scalar_of(entry["value"])
+            if v is not None:
+                out[rank] = v
+        return out
+
+    def _update_fleet_gauges(self, fresh):
+        means = self._rank_step_means(fresh)
+        self.last_skew_s = (max(means.values()) - min(means.values())
+                            if len(means) >= 2 else None)
+        reg = self.registry
+        if self.last_skew_s is not None:
+            reg.gauge(
+                "training_step_time_skew_seconds",
+                "max - min of per-rank mean step time (straggler skew)"
+            ).set(self.last_skew_s)
+        reg.gauge("cluster_ranks_reporting",
+                  "ranks with a fresh metrics snapshot").set(len(fresh))
+        reg.gauge("cluster_ranks_stale",
+                  "ranks whose snapshot aged out (or never arrived)"
+                  ).set(len(self.stale_ranks) + len(self.missing_ranks))
+
+    # ---- rendering ------------------------------------------------------
+    def merged_snapshot(self, collect=True):
+        """JSON-able fleet view: per-rank snapshots + staleness + skew
+        (the telemetry server's ``/varz`` embeds this as ``cluster``)."""
+        fresh = self.collect() if collect else self._last
+        return {
+            "world_size": self.world_size,
+            "ranks": {str(r): p for r, p in sorted(fresh.items())},
+            "stale_ranks": self.stale_ranks,
+            "missing_ranks": self.missing_ranks,
+            "step_time_skew_seconds": self.last_skew_s,
+            "per_rank_step_mean_s": {
+                str(r): v
+                for r, v in sorted(self._rank_step_means(fresh).items())},
+        }
+
+    def expose_prometheus(self, collect=True):
+        """Fleet-wide Prometheus text exposition, every series labelled
+        ``rank="<r>"``.  Histogram snapshots render as summaries."""
+        fresh = self.collect() if collect else self._last
+        kinds, order = {}, []
+        for _, payload in sorted(fresh.items()):
+            for name, entry in payload.get("metrics", {}).items():
+                if name not in kinds:
+                    kinds[name] = entry.get("type", "untyped")
+                    order.append(name)
+        lines = []
+        for name in order:
+            kind = kinds[name]
+            pname = _prom_name(name)
+            lines.append(f"# HELP {pname} {name} (merged across ranks)")
+            lines.append(f"# TYPE {pname} "
+                         f"{'summary' if kind == 'histogram' else kind}")
+            for rank, payload in sorted(fresh.items()):
+                entry = payload.get("metrics", {}).get(name)
+                if entry is None or entry.get("type") != kind:
+                    continue    # one name, one kind; mismatches dropped
+                for labels, value in self._series_of(entry, rank):
+                    lines.extend(self._render(pname, kind, labels, value))
+        lines.extend(self._fleet_lines(set(order)))
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _series_of(entry, rank):
+        if "series" in entry:
+            for s in entry["series"]:
+                kv = {"rank": str(rank), **s.get("labels", {})}
+                yield _fmt_labels(kv.keys(), kv.values()), s.get("value")
+        else:
+            yield f'rank="{rank}"', entry.get("value")
+
+    @staticmethod
+    def _render(pname, kind, labels, value):
+        if kind == "gauge" and isinstance(value, dict):
+            out = []
+            if value.get("current") is not None:
+                out.append(_prom_line(pname, labels, value["current"]))
+            if value.get("peak") is not None:
+                out.append(_prom_line(pname + "_peak", labels,
+                                      value["peak"]))
+            return out
+        if kind == "histogram" and isinstance(value, dict):
+            out = []
+            count = value.get("count") or 0
+            for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                           ("0.99", "p99")):
+                v = value.get(key)
+                if v is not None:
+                    out.append(_prom_line(
+                        pname, labels + f',quantile="{q}"', v))
+            mean = value.get("mean")
+            out.append(_prom_line(pname + "_sum", labels,
+                                  (mean or 0.0) * count))
+            out.append(_prom_line(pname + "_count", labels, count))
+            return out
+        if isinstance(value, (int, float)):
+            return [_prom_line(pname, labels, value)]
+        return []
+
+    def _fleet_lines(self, seen_names):
+        """Fleet-level series (no rank label) appended after the merge —
+        fresh from THIS collect, not one publish interval behind.  TYPE
+        lines are skipped for names the merge already declared (rank 0
+        republishes the fleet gauges from its local registry)."""
+        lines = []
+        fleet = [("training_step_time_skew_seconds", self.last_skew_s),
+                 ("cluster_ranks_reporting", len(self._last)),
+                 ("cluster_ranks_stale",
+                  len(self.stale_ranks) + len(self.missing_ranks))]
+        for name, value in fleet:
+            if value is None:
+                continue
+            if name not in seen_names:
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(_prom_line(name, "", value))
+        return lines

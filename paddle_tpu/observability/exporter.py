@@ -10,8 +10,12 @@ enforces that):
   trains or serves:
 
   ===========  ========================================================
-  ``/metrics``  Prometheus text exposition of the MetricsRegistry
-  ``/varz``     JSON registry snapshot + compile-watchdog report
+  ``/metrics``  Prometheus text exposition of the MetricsRegistry; with
+                an ``aggregator`` attached (rank 0 of a fleet), the
+                merged cross-rank exposition instead — every series
+                labelled ``rank="<r>"``, one scrape for the whole job
+  ``/varz``     JSON registry snapshot + compile-watchdog report (plus
+                the fleet ``cluster`` view when aggregating)
   ``/healthz``  serving health: healthy flag, queue depth, page
                 occupancy, and the engine's ``estimated_drain_s``
                 (HTTP 503 while shedding — load balancers eject on
@@ -201,8 +205,10 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         try:
             if url.path == "/metrics":
-                self._send(200, srv.registry.expose_prometheus(),
-                           ctype="text/plain; version=0.0.4")
+                body = (srv.aggregator.expose_prometheus()
+                        if srv.aggregator is not None
+                        else srv.registry.expose_prometheus())
+                self._send(200, body, ctype="text/plain; version=0.0.4")
             elif url.path == "/varz":
                 self._send(200, json.dumps(srv.varz()))
             elif url.path == "/healthz":
@@ -231,12 +237,14 @@ class TelemetryServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
-    def __init__(self, addr, registry, tracer, engine, watchdog):
+    def __init__(self, addr, registry, tracer, engine, watchdog,
+                 aggregator=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
         self.engine = engine
         self.watchdog = watchdog
+        self.aggregator = aggregator
         self._serve_thread = None
 
     # ---- payload builders ----------------------------------------------
@@ -246,9 +254,12 @@ class TelemetryServer(ThreadingHTTPServer):
             from .compile_watchdog import default_watchdog
 
             wd = default_watchdog()
-        return {"pid": os.getpid(),
-                "metrics": self.registry.snapshot(),
-                "jit": wd.report()}
+        out = {"pid": os.getpid(),
+               "metrics": self.registry.snapshot(),
+               "jit": wd.report()}
+        if self.aggregator is not None:
+            out["cluster"] = self.aggregator.merged_snapshot()
+        return out
 
     def healthz(self):
         """Live serving health.  With an engine attached its
@@ -267,7 +278,7 @@ class TelemetryServer(ThreadingHTTPServer):
                 "queue_depth": gauge_value("serving_queue_depth"),
                 "page_occupancy": gauge_value("serving_page_occupancy"),
                 "estimated_drain_s":
-                    gauge_value("serving_estimated_drain_s")}
+                    gauge_value("serving_estimated_drain_seconds")}
 
     # ---- lifecycle ------------------------------------------------------
     @property
@@ -301,7 +312,8 @@ class TelemetryServer(ThreadingHTTPServer):
 
 
 def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
-                           tracer=None, engine=None, watchdog=None):
+                           tracer=None, engine=None, watchdog=None,
+                           aggregator=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -309,7 +321,10 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     depth, occupancy and ``estimated_drain_s`` straight from the
     scheduler; without it the serving gauges in ``registry`` are used.
     ``tracer`` defaults to the engine's tracer when one is attached,
-    else the process-wide :func:`default_tracer`.  Never called on
+    else the process-wide :func:`default_tracer`.  ``aggregator`` (an
+    :class:`~paddle_tpu.observability.aggregate.ClusterAggregator`,
+    rank-0 only) switches ``/metrics`` to the merged fleet exposition
+    and embeds the ``cluster`` view in ``/varz``.  Never called on
     import anywhere in the framework — telemetry is strictly opt-in.
     """
     if tracer is None:
@@ -318,5 +333,5 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                   else default_tracer())
     srv = TelemetryServer((host, int(port)),
                           registry or default_registry(), tracer,
-                          engine, watchdog)
+                          engine, watchdog, aggregator=aggregator)
     return srv._start()

@@ -234,7 +234,38 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics = {}
+        self._collectors = []
         self._lock = threading.RLock()
+
+    # ---- collectors ------------------------------------------------------
+    def add_collector(self, fn):
+        """Register a zero-arg callable invoked at the top of every
+        scrape (``snapshot``/``expose_prometheus``) to sync an external
+        source into this registry — the bridge hook for legacy stat
+        registries (see ``utils.monitor.bridge_to_metrics``).  A
+        collector that raises is logged and skipped: scrape must never
+        500 because one bridge broke."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+        return fn
+
+    def remove_collector(self, fn):
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def _run_collectors(self):
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                import logging
+
+                logging.getLogger("paddle_tpu.observability").warning(
+                    "metrics collector %r failed", fn, exc_info=True)
 
     # ---- registration ---------------------------------------------------
     def register(self, metric, replace=False):
@@ -301,6 +332,7 @@ class MetricsRegistry:
 
     def snapshot(self):
         """JSON-able {name: {type, value|series}} of every metric."""
+        self._run_collectors()
         out = {}
         for m in self.metrics():
             entry = {"type": m.kind}
@@ -317,6 +349,7 @@ class MetricsRegistry:
 
     def expose_prometheus(self):
         """Prometheus text exposition format 0.0.4."""
+        self._run_collectors()
         lines = []
         for m in self.metrics():
             name = _prom_name(m.name)

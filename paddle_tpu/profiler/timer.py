@@ -63,15 +63,32 @@ class Benchmark:
             self._info.steps += 1
 
     def step_info(self, unit="samples"):
+        """Steady-state reader/step breakdown as a dict — the
+        programmatic surface (goodput accounting and bench consume the
+        totals; nothing should re-parse a formatted string).  Averages
+        are per counted step; ``*_total`` fields are cumulative seconds
+        over the counted (post-warmup) window."""
         i = self._info
-        avg = i.batch_cost / i.steps if i.steps else 0.0
+        span = i.reader_cost + i.batch_cost
         return {
             "ips": i.ips,
-            "avg_batch_cost": avg,
+            "avg_batch_cost": i.batch_cost / i.steps if i.steps else 0.0,
             "reader_cost": i.reader_cost / i.steps if i.steps else 0.0,
             "steps": i.steps,
             "unit": f"{unit}/sec",
+            "samples": i.samples,
+            "batch_cost_total": i.batch_cost,
+            "reader_cost_total": i.reader_cost,
+            "reader_ratio": i.reader_cost / span if span > 0 else 0.0,
         }
+
+    def take_pending_reader_cost(self):
+        """Return and clear reader time stashed by ``after_reader`` but
+        not yet committed by ``step_end`` — callers that re-attribute a
+        gap (e.g. the goodput accountant claiming epoch-end eval time)
+        drain it here so the next step doesn't double-bill it."""
+        pending, self._pending_reader_cost = self._pending_reader_cost, 0.0
+        return pending
 
     @property
     def ips(self):

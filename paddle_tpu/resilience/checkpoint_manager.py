@@ -153,10 +153,25 @@ class CheckpointManager:
         return self.step_path(step)
 
     def _bg_save(self, tree, step, extra):
+        import time
+
+        t0 = time.perf_counter()
         try:
             self._write_and_commit(tree, step, extra)
         except BaseException as e:          # surfaced by wait()/next save
             self._error = e
+            return
+        # the overlapped (off-training-thread) write time: compare with
+        # the sync/async series the CheckpointCallback records to see
+        # how much wall-clock async saving actually hides
+        from ..observability.metrics import default_registry
+
+        default_registry().histogram(
+            "checkpoint_save_seconds",
+            "checkpoint save duration by mode (sync/async block the "
+            "training thread; background is the overlapped write)",
+            labelnames=("mode",),
+        ).labels(mode="background").observe(time.perf_counter() - t0)
 
     def wait(self):
         """Join an in-flight async save; re-raise its failure here (the

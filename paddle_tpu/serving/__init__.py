@@ -22,7 +22,7 @@ Drain-estimate contract: every RETRY_AFTER request carries
 seconds derived from the live backlog (queued + running decode tokens
 still owed) divided by the engine's EWMA decode rate
 (``Engine.estimated_drain_s()``).  The same figure is published as the
-``serving_estimated_drain_s`` gauge and on the telemetry server's
+``serving_estimated_drain_seconds`` gauge and on the telemetry server's
 ``/healthz`` (README "Flight recorder"), so front-ends and fleet
 schedulers back off by measured drain time, not a guessed constant.
 Every request is additionally traced queued→prefill→decode[i]→terminal

@@ -44,7 +44,7 @@ Overload robustness (the production-traffic contract):
   EWMA decode rate), so a cooperating front-end backs off for exactly
   as long as the backlog needs instead of hammering a bare
   RETRY_AFTER.  The same figure is published on ``/healthz`` and the
-  ``serving_estimated_drain_s`` gauge.
+  ``serving_estimated_drain_seconds`` gauge.
 
 Flight recorder: every request is traced — a root span per request
 (one chrome-trace track), with ``queued`` / ``prefill`` /

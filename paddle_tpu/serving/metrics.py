@@ -71,15 +71,17 @@ class ServingMetrics:
         self.prefill_tokens = add(Counter("serving_prefill_tokens_total"))
         self.tokens_generated = add(Counter(
             "serving_tokens_generated_total"))
-        self.queue_wait = add(Histogram("serving_queue_wait_s"))
-        self.ttft = add(Histogram("serving_ttft_s"))
-        self.decode_token = add(Histogram("serving_decode_token_s"))
+        # unit suffixes are canonical (_seconds, not _s) —
+        # tools/check_metric_names.py (tier-1) enforces that too
+        self.queue_wait = add(Histogram("serving_queue_wait_seconds"))
+        self.ttft = add(Histogram("serving_ttft_seconds"))
+        self.decode_token = add(Histogram("serving_decode_token_seconds"))
         self.page_occupancy = add(Gauge("serving_page_occupancy"))
         self.queue_depth = add(Gauge(
             "serving_queue_depth",
             help="requests waiting in the admission queue"))
         self.estimated_drain_s = add(Gauge(
-            "serving_estimated_drain_s",
+            "serving_estimated_drain_seconds",
             help="estimated seconds to drain all queued + running work "
                  "at the EWMA decode rate — the RETRY_AFTER hint"))
 

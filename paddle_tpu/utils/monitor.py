@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "device_memory_stats"]
+           "bridge_to_metrics", "device_memory_stats"]
 
 
 class _Stat:
@@ -73,6 +73,56 @@ def stat_get(name):
 
 def stat_reset(name=None):
     _default.reset(name)
+
+
+def bridge_to_metrics(stat_registry=None, metrics_registry=None):
+    """One-way bridge: surface a :class:`StatRegistry`'s counters/peaks
+    in the observability :class:`MetricsRegistry` as the
+    ``runtime_stat{name=...}`` gauge family.
+
+    The sync runs *on scrape* (a registry collector fires at the top of
+    every ``snapshot()``/``expose_prometheus()``), so legacy
+    ``stat_add`` call sites keep their lock-cheap integer registry but
+    their stats still appear on ``/metrics`` and in bench JSON instead
+    of living in a parallel, invisible registry.  Peaks ride the gauge's
+    own peak tracking (the peak is replayed before the current value,
+    so ``runtime_stat_peak`` is never below the stat's true peak).
+
+    Defaults bridge the process-wide pair; the default bridge is
+    installed once at import of this module.  Returns the collector so
+    callers wiring explicit registries can ``remove_collector`` it."""
+    from ..observability.metrics import default_registry
+
+    sr = stat_registry if stat_registry is not None else _default
+    mr = metrics_registry if metrics_registry is not None \
+        else default_registry()
+
+    def _collect():
+        stats = sr.stats()
+        if not stats:
+            return
+        g = mr.gauge("runtime_stat",
+                     "legacy StatRegistry counters (bridged on scrape)",
+                     labelnames=("name",))
+        for name, (value, peak) in stats.items():
+            child = g.labels(name=name)
+            child.set(peak)
+            child.set(value)
+
+    return mr.add_collector(_collect)
+
+
+_BRIDGED = False
+
+
+def _install_default_bridge():
+    global _BRIDGED
+    if not _BRIDGED:
+        _BRIDGED = True
+        bridge_to_metrics()
+
+
+_install_default_bridge()
 
 
 def device_memory_stats(device=None):

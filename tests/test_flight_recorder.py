@@ -250,7 +250,7 @@ class TestRetryAfterHint:
         assert h["estimated_drain_s"] > 0
         assert h["queue_depth"] == 1
         snap = eng.metrics.registry.snapshot()
-        assert snap["serving_estimated_drain_s"]["value"]["current"] > 0
+        assert snap["serving_estimated_drain_seconds"]["value"]["current"] > 0
         assert snap["serving_queue_depth"]["value"]["current"] == 1
 
 
@@ -356,7 +356,7 @@ class TestTelemetryServerE2E:
             assert "# TYPE serving_requests_submitted_total counter" \
                 in body
             assert "serving_requests_submitted_total 2" in body
-            assert "serving_ttft_s_bucket" in body
+            assert "serving_ttft_seconds_bucket" in body
 
             code, ctype, body = _get(srv.url + "/healthz")
             health = json.loads(body)

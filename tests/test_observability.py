@@ -405,7 +405,7 @@ class TestServingMetricsThinClient:
         m.ttft.observe(0.1)
         snap = default_registry().snapshot()
         assert snap["serving_requests_submitted_total"]["value"] == 2
-        assert snap["serving_ttft_s"]["value"]["count"] == 1
+        assert snap["serving_ttft_seconds"]["value"]["count"] == 1
         # rebuild = reset: fresh series replace the old ones globally
         m2 = ServingMetrics()
         assert default_registry().snapshot()[

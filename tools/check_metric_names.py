@@ -12,7 +12,14 @@ scanned rules (literal first-argument names to ``Counter(`` /
 - names are ``snake_case`` (``^[a-z][a-z0-9_]*$``);
 - counter names end in ``_total``;
 - a name never appears with two different metric kinds across the
-  codebase.
+  codebase;
+- unit suffixes are canonical: a gauge or histogram name must not use
+  an abbreviated unit (``_s``, ``_ms``, ``_secs``, ``_kb``, ``_pct``,
+  ...) — spell it ``_seconds`` / ``_bytes`` / ``_ratio``;
+- histograms always measure a quantity, so a histogram name must END
+  in one of the canonical unit suffixes (a ``step_time`` histogram
+  whose unit a dashboard has to guess is a recording-rule bug waiting
+  to happen).  Unitless gauges (counts, 0/1 flags) stay suffix-free.
 
 Run directly (exit 1 on violations) or import ``check()`` — a tier-1
 test wires it into the suite like ``check_atomic_writes``, so a
@@ -34,6 +41,14 @@ _METRIC_CALL = re.compile(
         \s*\(\s*(?P<q>['"])(?P<name>[^'"]+)(?P=q)""", re.VERBOSE)
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# canonical unit suffixes for quantity-bearing series
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio")
+# abbreviated / non-canonical unit spellings that MUST NOT end a gauge
+# or histogram name
+_BAD_UNIT = re.compile(
+    r"_(s|sec|secs|ms|millis|micros|us|ns|min|mins|minutes|hr|hrs|"
+    r"hours|kb|mb|gb|tb|kib|mib|gib|pct|percent)$")
 
 
 def check(root=None):
@@ -70,6 +85,20 @@ def check(root=None):
                     violations.append(
                         f"{where}: counter {name!r} must end in "
                         "'_total' (Prometheus convention)")
+                if kind in ("gauge", "histogram"):
+                    m_bad = _BAD_UNIT.search(name)
+                    if m_bad:
+                        violations.append(
+                            f"{where}: {kind} {name!r} uses the "
+                            f"non-canonical unit suffix "
+                            f"'_{m_bad.group(1)}' — spell it out "
+                            f"({'/'.join(_UNIT_SUFFIXES)})")
+                    elif kind == "histogram" and \
+                            not name.endswith(_UNIT_SUFFIXES):
+                        violations.append(
+                            f"{where}: histogram {name!r} must end in "
+                            f"a canonical unit suffix "
+                            f"({'/'.join(_UNIT_SUFFIXES)})")
                 prev = seen.get(name)
                 if prev is not None and prev[0] != kind:
                     violations.append(
