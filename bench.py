@@ -414,6 +414,61 @@ def bench_ps(rows=100_000, dim=64, batch=4096):
             s.stop()
 
 
+def bench_resilience(param_mb=64, steps=8, save_every=2):
+    """Checkpoint-overlap measurement: how much save wall-clock async
+    mode hides from the training thread.  A synthetic ~param_mb state
+    tree is checkpointed every ``save_every`` of ``steps`` simulated
+    train steps, once with blocking saves and once async — the
+    training-thread cost (``checkpoint_save_seconds{mode=sync|async}``)
+    against the overlapped write (``mode="background"``) is the goodput
+    accountant's evidence that async checkpointing actually overlaps.
+    Pure host benchmark — no TPU."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.observability import default_registry
+    from paddle_tpu.resilience import CheckpointManager
+
+    rng = np.random.RandomState(0)
+    n = int(param_mb * (1 << 20) / 8 / 4)
+    tree = {f"layer{i}": rng.randn(n).astype(np.float32)
+            for i in range(8)}
+    out = {"param_mb": param_mb, "steps": steps, "save_every": save_every}
+    for mode, async_save in (("sync", False), ("async", True)):
+        root = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+        mgr = CheckpointManager(root, keep_last_n=2,
+                                async_save=async_save)
+        blocked, wall0 = [], time.perf_counter()
+        try:
+            for s in range(1, steps + 1):
+                time.sleep(0.01)            # the "train step"
+                if s % save_every == 0:
+                    t0 = time.perf_counter()
+                    mgr.save(tree, step=s)
+                    blocked.append(time.perf_counter() - t0)
+            mgr.wait()
+            out[mode] = {
+                "train_thread_save_s_p50": float(np.median(blocked)),
+                "train_thread_save_s_total": float(np.sum(blocked)),
+                "wall_s": time.perf_counter() - wall0,
+            }
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    h = default_registry().get("checkpoint_save_seconds")
+    if h is not None:
+        out["checkpoint_save_seconds"] = {
+            lv[0] if lv else "": child.summary()
+            for lv, child in h._series()}
+    out["overlap_ratio"] = 1.0 - (
+        out["async"]["train_thread_save_s_total"]
+        / max(out["sync"]["train_thread_save_s_total"], 1e-9))
+    log(f"[resilience] ckpt {param_mb}MB: sync blocks "
+        f"{out['sync']['train_thread_save_s_total']:.3f}s, async "
+        f"blocks {out['async']['train_thread_save_s_total']:.3f}s "
+        f"({out['overlap_ratio']*100:.0f}% of save wall hidden)")
+    return out
+
+
 # ----------------------------------------------------- section telemetry
 
 
@@ -580,7 +635,7 @@ def main():
     ap.add_argument("--no-serving", action="store_true")
     ap.add_argument("--section",
                     choices=["gpt", "rung", "flash", "resnet", "ps",
-                             "serving"],
+                             "serving", "resilience"],
                     help="internal: run ONE section in-process, print "
                          "its JSON")
     ap.add_argument("--rung", type=int, default=0,
@@ -620,6 +675,9 @@ def main():
         return
     if args.section == "serving":
         print(json.dumps(_section_telemetry(bench_serving())))
+        return
+    if args.section == "resilience":
+        print(json.dumps(_section_telemetry(bench_resilience())))
         return
 
     # ---- orchestrator: every section in its own subprocess ----
@@ -676,6 +734,8 @@ def main():
     if not args.no_serving:
         extra["serving"] = _run_section(["--section", "serving"],
                                         timeout_s=1500, tag="serving")
+    extra["resilience"] = _run_section(["--section", "resilience"],
+                                       timeout_s=600, tag="resilience")
 
     # ---- regression gate: >5% drop vs any prior round fails the bench
     best = prior_best()

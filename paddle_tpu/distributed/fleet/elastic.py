@@ -126,11 +126,15 @@ class ElasticManager:
         peer's stale counter counts it alive only until the window
         expires, so a match built on corpses breaks before we return.
         The deadline is therefore extended to fit at least one full hold
-        window (timeout < node_timeout could otherwise never succeed)."""
-        deadline = time.time() + max(timeout,
-                                     self.node_timeout + 2 * self.heartbeat_interval)
+        window (timeout < node_timeout could otherwise never succeed).
+
+        Deadlines ride the monotonic clock, same as liveness: an NTP
+        wall-clock step must not spuriously expire (or extend) a
+        rendezvous that a peer's heartbeat window would survive."""
+        deadline = time.monotonic() + max(
+            timeout, self.node_timeout + 2 * self.heartbeat_interval)
         held_since = None
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             if self.match(hosts):
                 now = time.monotonic()
                 if held_since is None:
@@ -144,9 +148,11 @@ class ElasticManager:
 
     def watch(self, hosts, timeout=60.0):
         """Block until membership breaks (a host dies) or timeout.
-        Returns ('lost', [hosts]) / ('ok', []) on timeout."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        Returns ('lost', [hosts]) / ('ok', []) on timeout.  The
+        deadline is monotonic — wall-clock steps can't cut a watch
+        short or pin it open."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             dead = [h for h in hosts if not self.probe(h)]
             if dead:
                 return ("lost", dead)

@@ -299,17 +299,20 @@ def _apply_fit_state(model, tree, extra):
         sched.set_state_dict(sched_state)
 
 
-def restore_fit_state(model, resume_from):
+def restore_fit_state(model, resume_from, before_step=None):
     """Restore the newest intact fit checkpoint under ``resume_from``
     into ``model``.  Returns the manifest ``extra`` dict (epoch /
     next_step / global_step) or None when no checkpoint exists yet —
-    first launch and relaunch-after-crash are then the same code path."""
+    first launch and relaunch-after-crash are then the same code path.
+    ``before_step`` restricts the walk to checkpoints strictly older
+    (the health-rollback path must not restore the anomalous step's own
+    save, which is intact on disk but numerically poisoned)."""
     from ..resilience import CheckpointManager
 
     mgr = resume_from if isinstance(resume_from, CheckpointManager) \
         else CheckpointManager(resume_from)
     try:
-        _, flat, manifest = mgr.restore()
+        _, flat, manifest = mgr.restore(before_step=before_step)
     except FileNotFoundError:
         return None
     extra = manifest.get("extra", {})
@@ -345,10 +348,16 @@ class CheckpointCallback(Callback):
         self.every_n_steps = int(every_n_steps)
         self._epoch = 0
         self._global_step = 0
+        self._skipped_windows = []
 
     def on_train_begin(self, logs=None):
         info = getattr(self.model, "_resume_info", None) or {}
         self._global_step = int(info.get("global_step", 0))
+        # skipped windows survive resume: they ride in every later
+        # manifest so an operator can always see what data a rollback
+        # dropped, however many relaunches later
+        self._skipped_windows = [dict(w) for w
+                                 in info.get("skipped_windows", [])]
 
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
@@ -361,6 +370,17 @@ class CheckpointCallback(Callback):
     def on_train_end(self, logs=None):
         self.manager.wait()        # surface a failed async save here
 
+    def record_rollback(self, window, next_step):
+        """Make a health rollback durable: remember the skipped data
+        window and immediately commit a checkpoint of the (restored)
+        state whose ``next_step`` points past it — a process killed one
+        instant after the rollback resumes beyond the poisoned batch
+        instead of replaying it.  The save lands at the current
+        ``global_step``, superseding the poisoned save the anomalous
+        step may have committed moments earlier."""
+        self._skipped_windows.append(dict(window))
+        self._save(next_step=next_step)
+
     def _save(self, next_step):
         t0 = time.perf_counter()
         tree, rng_counters = _pack_fit_state(self.model)
@@ -371,6 +391,9 @@ class CheckpointCallback(Callback):
             "global_step": self._global_step,
             "rng_counters": rng_counters,
         }
+        if self._skipped_windows:
+            extra["skipped_windows"] = [dict(w) for w
+                                        in self._skipped_windows]
         sched = _lr_scheduler_of(self.model)
         if sched is not None:
             extra["lr_scheduler"] = sched.state_dict()

@@ -56,6 +56,7 @@ class Model:
         self._mesh = None        # dp mesh (prepare(device_mesh=...))
         self._watch_grad_norm = False   # train_batch reports grad_norm
         self._jit_step_gnorm = False    # arity the built step returns
+        self._rollback_request = None   # set by HealthMonitor(rollback)
 
     def enable_grad_norm_logging(self):
         """Make ``train_batch`` report the global gradient norm in its
@@ -306,6 +307,7 @@ class Model:
                                       "verbose": verbose,
                                       "metrics": self._metric_names()})
         self.stop_training = False
+        self._rollback_request = None
         cblist.on_train_begin()
         history = []
         logs = {}
@@ -332,6 +334,14 @@ class Model:
                     sp.set_attribute("loss", float(loss))
                 logs = {"loss": loss, **res}
                 cblist.on_train_batch_end(step, logs)
+                if self._rollback_request is not None:
+                    # a HealthMonitor(action="rollback") flagged this
+                    # step: restore the last-good checkpoint and let the
+                    # loop continue with the NEXT batch — the offending
+                    # data window is skipped, durably
+                    req, self._rollback_request = \
+                        self._rollback_request, None
+                    self._execute_rollback(req, cblist, epoch, step)
                 # simulated-preemption site: crash-consistency tests kill
                 # fit here, AFTER the checkpoint callback ran for this step
                 fault_point("hapi.train_step")
@@ -347,6 +357,68 @@ class Model:
                 break
         cblist.on_train_end(logs)
         return history
+
+    def _execute_rollback(self, req, cblist, epoch, step):
+        """Health-triggered rollback: restore the newest intact
+        checkpoint *older than the anomalous step* and record the
+        skipped data window.
+
+        The loop position does not move — training simply continues
+        with the next batch on last-good params, so batches between the
+        restored checkpoint and the anomaly (the poisoned batch plus up
+        to ``every_n_steps - 1`` good ones, the documented skipped-
+        window granularity) are never replayed.  The window is
+        committed to the checkpoint manifest immediately, so a crash
+        right after the rollback resumes past it too."""
+        from ..observability.health import TrainingHealthError
+        from .callbacks import CheckpointCallback, restore_fit_state
+
+        reason = req.get("reason", "anomaly")
+        cb = next((c for c in cblist.callbacks
+                   if isinstance(c, CheckpointCallback)), None)
+        if cb is None:
+            raise TrainingHealthError(
+                reason, f"rollback requested at step {step} but no "
+                        f"CheckpointCallback is attached — there is "
+                        f"nothing to roll back to")
+        cb.manager.wait()          # join an in-flight poisoned save
+        bad_global_step = cb._global_step
+        info = restore_fit_state(self, cb.manager,
+                                 before_step=bad_global_step)
+        if info is None:
+            raise TrainingHealthError(
+                reason, f"rollback requested at step {step} but no "
+                        f"intact checkpoint precedes global step "
+                        f"{bad_global_step}")
+        window = {
+            "reason": reason,
+            "epoch": int(epoch),
+            # data-stream positions: batches first_step..last_step of
+            # this epoch were trained then discarded — a resume never
+            # sees them again
+            "first_step": int(info.get("next_step", 0)),
+            "last_step": int(step),
+            "global_step": int(bad_global_step),
+            "restored_global_step": int(info.get("global_step", 0)),
+        }
+        cb.record_rollback(window, next_step=step + 1)
+        from ..observability.metrics import default_registry
+        from ..observability.tracing import default_tracer
+
+        default_registry().counter(
+            "training_rollbacks_total",
+            "health-triggered restores of the last good checkpoint",
+            labelnames=("reason",)).labels(reason=reason).inc()
+        span = default_tracer().start_trace("supervisor::rollback",
+                                            attributes=dict(window))
+        span.end()
+        import logging
+
+        logging.getLogger("paddle_tpu.hapi").warning(
+            "rolled back to checkpoint step %s after %s at epoch %d "
+            "step %d; skipping data window [%d, %d]",
+            window["restored_global_step"], reason, epoch, step,
+            window["first_step"], window["last_step"])
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
                  callbacks=None, **kw):

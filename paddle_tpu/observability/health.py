@@ -33,7 +33,18 @@ each event the monitor
   /step timeline the run went bad), and
 - applies ``action``: ``"warn"`` logs a WARNING, ``"gauge"`` only flips
   the gauge, ``"raise"`` raises :class:`TrainingHealthError` out of
-  ``Model.fit`` (for CI canaries where a sick run must die loudly).
+  ``Model.fit`` (for CI canaries where a sick run must die loudly), and
+  ``"rollback"`` turns the monitor from an observer into an actor: on a
+  ``non_finite_loss``/``grad_spike`` anomaly (``rollback_kinds``) it
+  asks ``Model.fit`` to restore the last-good checkpoint and skip the
+  offending data window — training continues from known-good params
+  with the poisoned batch never replayed (see
+  ``Model._execute_rollback``; requires a ``CheckpointCallback`` in the
+  same fit).  Each rollback increments
+  ``training_rollbacks_total{reason=...}``; more than ``max_rollbacks``
+  per run escalates to :class:`TrainingHealthError` — a run that needs
+  rolling back every few steps is sick in a way rollback can't fix.
+  Kinds outside ``rollback_kinds`` degrade to ``"warn"`` behaviour.
 """
 from __future__ import annotations
 
@@ -48,7 +59,7 @@ __all__ = ["HealthMonitor", "TrainingHealthError"]
 
 logger = logging.getLogger("paddle_tpu.observability")
 
-_ACTIONS = ("warn", "gauge", "raise")
+_ACTIONS = ("warn", "gauge", "raise", "rollback")
 
 
 class TrainingHealthError(RuntimeError):
@@ -102,11 +113,15 @@ class HealthMonitor(TrainingCallback):
                  grad_zscore=6.0, step_time_zscore=6.0,
                  plateau_window=0, plateau_min_delta=1e-4,
                  watch_grad_norm=True, skip_first_steps=1,
-                 recover_after=1, registry=None, tracer=None, clock=None):
+                 recover_after=1, rollback_kinds=("non_finite_loss",
+                                                  "grad_spike"),
+                 max_rollbacks=3, registry=None, tracer=None, clock=None):
         super().__init__()
         if action not in _ACTIONS:
             raise ValueError(f"action must be one of {_ACTIONS}")
         self.action = action
+        self.rollback_kinds = tuple(rollback_kinds)
+        self.max_rollbacks = int(max_rollbacks)
         self.window = int(window)
         self.min_samples = int(min_samples)
         self.grad_zscore = float(grad_zscore)
@@ -134,6 +149,7 @@ class HealthMonitor(TrainingCallback):
         self._step = 0
         self._t_begin = None
         self.events = []            # [(kind, step, detail)] this run
+        self.rollbacks = 0          # rollbacks requested this run
 
     # ---- wiring ---------------------------------------------------------
     def registry(self):
@@ -258,7 +274,23 @@ class HealthMonitor(TrainingCallback):
                                          attributes=dict(detail))
         span.end()
         msg = f"training anomaly {kind} at step {step}: {detail}"
-        if self.action == "warn":
+        if self.action == "rollback" and kind in self.rollback_kinds:
+            self.rollbacks += 1
+            if self.rollbacks > self.max_rollbacks:
+                raise TrainingHealthError(
+                    kind, f"{msg} — rollback #{self.rollbacks} exceeds "
+                          f"max_rollbacks={self.max_rollbacks}; the run "
+                          f"is not recoverable by rewinding")
+            logger.warning("%s — requesting rollback to last good "
+                           "checkpoint", msg)
+            if self.model is not None:
+                # Model.fit executes this after the callback round for
+                # the step completes (so the checkpoint callback's
+                # bookkeeping for the poisoned step is already visible)
+                self.model._rollback_request = {"reason": kind,
+                                                "step": step}
+            return
+        if self.action in ("warn", "rollback"):
             logger.warning(msg)
         elif self.action == "raise":
             raise TrainingHealthError(kind, msg)

@@ -17,6 +17,11 @@ this package is the fault boundary that makes that survivable:
 - :mod:`.retry` — jittered exponential backoff (:func:`retry`,
   :func:`backoff_delays`) and :class:`Deadline`, adopted by the
   TCPStore client and the serving engine's per-request TTLs.
+- :mod:`.supervisor` — :class:`TrainingSupervisor`: runs the trainer
+  as a watched child process and autonomously relaunches it (jittered
+  backoff, ``max_restarts`` budget, elastic-membership rendezvous),
+  resuming from the newest intact checkpoint — preemption-to-resume
+  with zero operator action.
 
 Consumers: ``framework_io.save`` and ``jit.save`` write atomically;
 ``distributed.checkpoint`` checksums shards and exposes kill sites;
@@ -43,6 +48,11 @@ from .faults import (  # noqa: F401
     uninstall,
 )
 from .retry import Deadline, RetryError, backoff_delays, retry  # noqa: F401
+from .supervisor import (  # noqa: F401
+    ENV_ATTEMPT,
+    ENV_RESUME_DIR,
+    TrainingSupervisor,
+)
 
 __all__ = [
     "atomic_write", "CRC32Writer",
@@ -51,6 +61,7 @@ __all__ = [
     "install", "uninstall", "current_injector", "injected_faults",
     "install_from_env",
     "Deadline", "RetryError", "backoff_delays", "retry",
+    "TrainingSupervisor", "ENV_RESUME_DIR", "ENV_ATTEMPT",
 ]
 
 # env-gated fault injection: inert unless PADDLE_TPU_FAULTS is set

@@ -22,10 +22,14 @@ manifest; this module owns everything around that write that makes a
   after each successful commit (tmp droppings from crashed saves are
   swept opportunistically too).
 - **async save** — ``async_save=True`` snapshots the tree to host
-  memory synchronously (device arrays are mutable-in-place from the
-  trainer's view) and writes + commits on a background thread;
+  memory synchronously and writes + commits on a background thread;
   :meth:`wait` joins it and re-raises its failure.  The training
-  thread pays device→host copy time, not disk time.
+  thread pays device→host copy time, not disk time.  The snapshot is
+  a *deep* copy taken before the handoff: host-resident numpy leaves
+  are copied (``jax.device_get`` passes them through by reference)
+  and device arrays land in fresh host buffers, so a trainer that
+  immediately mutates or donates the live tree on the next step never
+  races the background write.
 
 Fault sites (see ``resilience.faults``): ``checkpoint.before_shard``,
 ``checkpoint.shard_write``, ``checkpoint.before_manifest``,
@@ -91,16 +95,50 @@ def verify_checkpoint(path):
     return not errors, errors
 
 
+def _host_snapshot(tree):
+    """Deep device→host copy of a checkpoint tree.
+
+    ``jax.device_get`` copies device arrays into fresh host buffers but
+    returns host numpy arrays *by reference* (and on CPU backends may
+    hand back a read-only view of the very buffer the trainer will
+    donate to the next step).  Every array leaf here ends up in memory
+    the background writer exclusively owns."""
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
+        if isinstance(x, jax.Array):
+            out = np.asarray(jax.device_get(x))
+            if not out.flags.owndata or not out.flags.writeable:
+                out = np.array(out, copy=True)
+            return out
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 class CheckpointManager:
     """Atomic, checksummed, retained checkpoints under one directory."""
 
-    def __init__(self, directory, keep_last_n=None, async_save=False):
+    def __init__(self, directory, keep_last_n=None, async_save=False,
+                 sweep_orphans=True):
         self.directory = os.fspath(directory)
         self.keep_last_n = keep_last_n
         self.async_save = bool(async_save)
         self._thread = None
         self._error = None
         os.makedirs(self.directory, exist_ok=True)
+        if sweep_orphans:
+            # reclaim step_N.tmp debris from a save killed mid-write in
+            # a PREVIOUS process (a crashed trainer's relaunch lands
+            # here before any new save runs — without this, every
+            # preemption leaks one tmp dir forever).  Only safe when no
+            # other process is writing this directory; pass
+            # sweep_orphans=False for read-side managers that may
+            # coexist with a live trainer.
+            self._sweep_tmp()
 
     # ------------------------------------------------------------ discovery
     def steps(self):
@@ -139,13 +177,15 @@ class CheckpointManager:
         device→host snapshot happens now and the write/commit happens on
         a background thread (a previous in-flight save is joined first,
         so saves never reorder)."""
-        self.wait()
         if not self.async_save:
+            self.wait()
             self._write_and_commit(tree, step, extra)
             return self.step_path(step)
-        import jax
-
-        host_tree = jax.device_get(tree)
+        # snapshot BEFORE joining the previous save: the caller's tree
+        # is only guaranteed step-consistent right now — the join may
+        # block on disk, the device→host copy must not wait for it
+        host_tree = _host_snapshot(tree)
+        self.wait()
         self._thread = threading.Thread(
             target=self._bg_save, args=(host_tree, step, extra),
             name=f"ckpt-save-{step}", daemon=True)
@@ -207,18 +247,24 @@ class CheckpointManager:
         self._gc()
 
     # ------------------------------------------------------------- restore
-    def restore(self, like_tree=None, step=None, verify=True):
+    def restore(self, like_tree=None, step=None, verify=True,
+                before_step=None):
         """Load the newest intact checkpoint (or exactly ``step``).
 
         Returns ``(step, tree, manifest)``; ``like_tree`` follows
         ``load_sharded`` semantics (sharded rebuild vs host dict).
         Walks back over corrupt checkpoints unless pinned to ``step``
         (an explicitly requested broken checkpoint should fail loudly).
+        ``before_step`` bounds the walk to steps strictly below it —
+        the health-rollback path uses it to refuse a checkpoint taken
+        at (or after) the anomalous step itself, which is intact
+        CRC-wise but numerically poisoned.
         Raises FileNotFoundError when nothing restorable exists."""
         from ..distributed.checkpoint import load_sharded
 
         candidates = [step] if step is not None else \
-            list(reversed(self.steps()))
+            [s for s in reversed(self.steps())
+             if before_step is None or s < int(before_step)]
         last_err = None
         for s in candidates:
             path = self.step_path(s)
@@ -240,14 +286,23 @@ class CheckpointManager:
             f"no intact checkpoint under {self.directory!r}{detail}")
 
     # ----------------------------------------------------------- retention
-    def _gc(self):
-        for name in os.listdir(self.directory):
+    def _sweep_tmp(self):
+        """Remove ``step_N.tmp`` debris from killed saves."""
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in entries:
             if name.endswith(".tmp"):
                 full = os.path.join(self.directory, name)
                 # a foreign pid may still be writing; only sweep our
                 # naming scheme's directories
                 if _STEP_RE.match(name[:-4]) and os.path.isdir(full):
                     shutil.rmtree(full, ignore_errors=True)
+                    self._count("checkpoint_tmp_swept_total")
+
+    def _gc(self):
+        self._sweep_tmp()
         if self.keep_last_n is None:
             return
         steps = self.steps()

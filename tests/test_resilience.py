@@ -238,6 +238,38 @@ class TestCheckpointManager:
         step, tree, _ = mgr.restore()
         _assert_state(tree, 1)
 
+    def test_async_save_snapshots_before_handoff(self, tmp_path):
+        """The device→host snapshot must be a deep copy taken before
+        the background thread starts: a trainer mutating (or donating)
+        its live tree immediately after save() returns must not be able
+        to tear the bytes being written.  jax.device_get alone passes
+        host numpy leaves through BY REFERENCE — this is the race."""
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        # stall the background write so the mutation below happens
+        # while the save is provably still in flight
+        live = _state(1)
+        with injected_faults(FaultSpec("checkpoint.before_shard",
+                                       "stall", stall_s=0.2)):
+            mgr.save(live, step=1)
+            live["w"][:] = -777.0          # the next "train step"
+            live["nested"]["b"][:] = -777.0
+            mgr.wait()
+        step, tree, _ = mgr.restore()
+        assert step == 1
+        _assert_state(tree, 1)             # pre-mutation values
+
+    def test_restore_before_step_skips_newer(self, tmp_path):
+        """before_step bounds the fallback walk: the rollback path must
+        never restore the anomalous step's own (poisoned) save."""
+        mgr = CheckpointManager(str(tmp_path))
+        for i in (1, 2, 3):
+            mgr.save(_state(i), step=i)
+        step, tree, _ = mgr.restore(before_step=3)
+        assert step == 2
+        _assert_state(tree, 2)
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(before_step=1)
+
     def test_corrupt_committed_checkpoint_falls_back(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path))
         mgr.save(_state(1), step=1)
@@ -274,6 +306,70 @@ class TestCrashConsistency:
                                             # not yet renamed
         ("checkpoint.before_commit", 1),    # dir complete, not renamed
     ]
+
+    def test_kill_after_commit_keeps_new_step(self, tmp_path):
+        """The rename IS the commit: a kill one instruction later
+        (checkpoint.after_commit) must find the NEW step restorable."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1), step=1)
+        with injected_faults(FaultSpec("checkpoint.after_commit",
+                                       "kill")):
+            with pytest.raises(SimulatedCrash):
+                mgr.save(_state(2), step=2)
+        assert CheckpointManager(str(tmp_path)).latest() == 2
+        step, tree, _ = mgr.restore()
+        assert step == 2
+        _assert_state(tree, 2)
+
+    def test_kill_mid_model_save_keeps_old_blob(self, tmp_path):
+        """hapi Model.save writes through atomic_write(site=
+        'hapi.model_save'): a kill mid-write leaves the previous
+        .pdparams intact."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import Model
+
+        paddle.seed(0)
+        model = Model(nn.Linear(4, 2))
+        path = str(tmp_path / "m")
+        model.save(path)
+        import pickle
+
+        with open(path + ".pdparams", "rb") as f:
+            before = pickle.load(f)
+        with injected_faults(FaultSpec("hapi.model_save", "kill")):
+            with pytest.raises(SimulatedCrash):
+                model.save(path)
+        with open(path + ".pdparams", "rb") as f:
+            after = pickle.load(f)
+        for k, v in before["params"].items():
+            np.testing.assert_array_equal(after["params"][k], v)
+
+    def test_killed_save_tmp_dir_swept_on_init(self, tmp_path):
+        """A step_N.tmp left by a kill-mid-save must be reclaimed by the
+        next manager construction (the relaunch path) — orphaned tmp
+        dirs must not accumulate across preemptions."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1), step=1)
+        with injected_faults(FaultSpec("checkpoint.before_manifest",
+                                       "kill")):
+            with pytest.raises(SimulatedCrash):
+                mgr.save(_state(2), step=2)
+        leftovers = [n for n in os.listdir(str(tmp_path))
+                     if n.endswith(".tmp")]
+        assert leftovers == ["step_0000000002.tmp"]
+        # a fresh manager (what a relaunched trainer constructs) sweeps
+        mgr2 = CheckpointManager(str(tmp_path))
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.endswith(".tmp")]
+        assert mgr2.latest() == 1          # committed step untouched
+        # read-side managers can opt out (a live trainer may be writing)
+        with injected_faults(FaultSpec("checkpoint.before_commit",
+                                       "kill")):
+            with pytest.raises(SimulatedCrash):
+                mgr2.save(_state(3), step=3)
+        CheckpointManager(str(tmp_path), sweep_orphans=False)
+        assert [n for n in os.listdir(str(tmp_path))
+                if n.endswith(".tmp")] == ["step_0000000003.tmp"]
 
     @pytest.mark.parametrize("site,occurrence", KILL_POINTS)
     def test_kill_point_recovers_previous_step(self, tmp_path, site,
@@ -543,6 +639,55 @@ class TestFitAutoResume:
         assert info["global_step"] == 1
         np.testing.assert_array_equal(jax.random.key_data(split_key()),
                                       expected)
+
+
+# ---------------------------------------------------- fault-sites lint
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), os.pardir,
+                           "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFaultSitesLint:
+    def test_repo_sites_all_exercised(self):
+        mod = _load_tool("check_fault_sites")
+        assert mod.check() == []
+
+    def test_known_sites_collected(self):
+        mod = _load_tool("check_fault_sites")
+        sites = mod.collect_sites()
+        # positional fault_point literals AND site= keyword literals
+        for expected in ("hapi.train_step", "checkpoint.before_commit",
+                         "checkpoint.shard_write", "supervisor.spawn",
+                         "supervisor.rendezvous", "framework_io.save"):
+            assert expected in sites, expected
+        # a keyword DEFAULT is not a registered site
+        assert "io.write" not in sites
+
+    def test_lint_catches_an_uncovered_site(self, tmp_path):
+        mod = _load_tool("check_fault_sites")
+        pkg = tmp_path / "pkg"
+        tests = tmp_path / "tests"
+        pkg.mkdir()
+        tests.mkdir()
+        (pkg / "thing.py").write_text(
+            "from x import fault_point, atomic_write\n"
+            "def f(p):\n"
+            "    fault_point('thing.covered')\n"
+            "    fault_point('thing.naked')\n"
+            "    with atomic_write(p, site='thing.kw') as fh:\n"
+            "        fh.write(b'x')\n")
+        (tests / "test_thing.py").write_text(
+            "SPEC = 'thing.covered:kill:1,thing.kw:io_error'\n")
+        out = mod.check(root=str(pkg), tests_root=str(tests))
+        assert len(out) == 1 and out[0].startswith("thing.naked ")
 
 
 # --------------------------------------------------- atomic-writes lint

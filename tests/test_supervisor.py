@@ -1,0 +1,283 @@
+"""TrainingSupervisor tests: autonomous relaunch on elastic-exit /
+crash / lost node, fault-matrix sites for kill-during-relaunch and
+store-outage-during-rendezvous, and the end-to-end acceptance run — a
+trainer killed mid-epoch (twice: once on the first run, once during
+the recovery run) relaunches with zero operator action and reproduces
+the uninterrupted loss curve bitwise.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  ElasticManager)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.observability import default_registry
+from paddle_tpu.resilience import (FaultSpec, TrainingSupervisor,
+                                   injected_faults)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _restarts(reason):
+    fam = default_registry().get("supervisor_restarts_total")
+    return fam.labels(reason=reason).value if fam else 0
+
+
+def _script(tmp_path, body):
+    p = tmp_path / "child.py"
+    p.write_text("import os, sys\n"
+                 "attempt = int(os.environ.get("
+                 "'PADDLE_RESTART_ATTEMPT', '0'))\n" + body)
+    return [sys.executable, str(p)]
+
+
+def _mgr(store, host, np_=1, **kw):
+    kw.setdefault("heartbeat_interval", 0.1)
+    kw.setdefault("node_timeout", 0.4)
+    return ElasticManager(store, job_id="sup", np=np_, host=host, **kw)
+
+
+class TestSupervisorRelaunch:
+    def test_clean_exit_passthrough(self, tmp_path):
+        sup = TrainingSupervisor(_script(tmp_path, "sys.exit(0)\n"),
+                                 max_restarts=3, backoff_base=0.01)
+        assert sup.run() == 0
+        assert sup.restarts == []
+
+    def test_elastic_exit_relaunches_and_resumes(self, tmp_path):
+        """ELASTIC_EXIT_CODE is a relaunch *request*: attempt 0 asks,
+        attempt 1 completes.  The resume env contract reaches every
+        attempt identically (first launch == Nth relaunch)."""
+        before = _restarts("elastic_exit")
+        body = (
+            "assert os.environ['PADDLE_ELASTIC_RESUME_DIR'] == "
+            f"{str(tmp_path / 'ck')!r}\n"
+            "with open(os.path.join("
+            f"{str(tmp_path)!r}, 'runs.log'), 'a') as f:\n"
+            "    f.write(f'attempt={attempt}\\n')\n"
+            f"sys.exit({ELASTIC_EXIT_CODE} if attempt == 0 else 0)\n")
+        sup = TrainingSupervisor(_script(tmp_path, body),
+                                 checkpoint_dir=str(tmp_path / "ck"),
+                                 max_restarts=2, backoff_base=0.01,
+                                 backoff_cap=0.02)
+        assert sup.run() == 0
+        assert sup.restarts == [("elastic_exit", 1)]
+        assert _restarts("elastic_exit") == before + 1
+        runs = (tmp_path / "runs.log").read_text().splitlines()
+        assert runs == ["attempt=0", "attempt=1"]
+
+    def test_restart_budget_exhaustion_propagates_code(self, tmp_path):
+        sup = TrainingSupervisor(_script(tmp_path, "sys.exit(3)\n"),
+                                 max_restarts=2, backoff_base=0.01,
+                                 backoff_cap=0.02)
+        assert sup.run() == 3
+        assert [r for r, _ in sup.restarts] == ["crash", "crash"]
+
+    @pytest.mark.faultinject
+    def test_kill_during_relaunch_survived(self, tmp_path):
+        """Fault-matrix site supervisor.spawn: the RELAUNCH itself dies
+        (io_error spawning attempt 1) on top of the original crash —
+        the supervisor burns another unit of restart budget and still
+        completes."""
+        before = _restarts("spawn_failed")
+        body = ("with open(os.path.join("
+                f"{str(tmp_path)!r}, 'runs.log'), 'a') as f:\n"
+                "    f.write(f'attempt={attempt}\\n')\n"
+                "sys.exit(7 if attempt == 0 else 0)\n")
+        sup = TrainingSupervisor(_script(tmp_path, body),
+                                 max_restarts=3, backoff_base=0.01,
+                                 backoff_cap=0.02)
+        with injected_faults(FaultSpec("supervisor.spawn", "io_error",
+                                       occurrence=2)):
+            assert sup.run() == 0
+        assert [r for r, _ in sup.restarts] == ["crash", "spawn_failed"]
+        assert _restarts("spawn_failed") == before + 1
+        runs = (tmp_path / "runs.log").read_text().splitlines()
+        assert runs == ["attempt=0", "attempt=2"]
+
+
+class TestSupervisorElastic:
+    @pytest.mark.faultinject
+    def test_store_outage_during_rendezvous_retried(self, tmp_path):
+        """Fault-matrix site supervisor.rendezvous: a transient store
+        outage while waiting for membership is retried with backoff —
+        it must not read as a dead fleet or crash the supervisor."""
+        store = TCPStore(is_master=True, world_size=1)
+        sup = TrainingSupervisor(
+            _script(tmp_path, "sys.exit(0)\n"),
+            elastic=_mgr(store, "me"), hosts=["me"],
+            max_restarts=1, backoff_base=0.01, backoff_cap=0.02,
+            rendezvous_timeout=20.0)
+        with injected_faults(FaultSpec("supervisor.rendezvous",
+                                       "io_error", occurrence=1)):
+            assert sup.run() == 0
+
+    def test_lost_node_terminates_and_relaunches(self, tmp_path):
+        """A dead peer mid-run: the supervisor kills the local trainer,
+        re-rendezvouses (waiting for the replacement), and relaunches."""
+        store = TCPStore(is_master=True, world_size=2)
+        peer = _mgr(store, "peer", np_=2)
+        peer.register()
+        before = _restarts("lost_node")
+        # attempt 0 hangs (a trainer wedged on a dead peer's collective);
+        # attempt 1 completes
+        body = ("import time\n"
+                "time.sleep(60 if attempt == 0 else 0)\n"
+                "sys.exit(0)\n")
+        sup = TrainingSupervisor(
+            _script(tmp_path, body),
+            elastic=_mgr(store, "me", np_=2), hosts=["me", "peer"],
+            max_restarts=1, backoff_base=0.01, backoff_cap=0.02,
+            membership_interval=0.1, rendezvous_timeout=30.0,
+            term_grace_s=5.0)
+        holder = {}
+
+        def chaos():
+            time.sleep(1.8)            # past the first rendezvous
+            peer.deregister()          # peer dies mid-run
+            time.sleep(1.0)
+            holder["peer2"] = _mgr(store, "peer", np_=2).register()
+
+        t = threading.Thread(target=chaos, daemon=True)
+        t.start()
+        try:
+            assert sup.run() == 0
+        finally:
+            t.join()
+            holder["peer2"].deregister()
+        assert [r for r, _ in sup.restarts] == ["lost_node"]
+        assert _restarts("lost_node") == before + 1
+
+
+# ------------------------------------------------- end-to-end acceptance
+
+# A real hapi trainer: 2 epochs x 4 steps on the PR-3 toy problem, a
+# CheckpointCallback every step, fit(resume_from=<supervisor contract>).
+# Attempts 0 and 1 install a kill fault (the second one DURING the
+# recovery run — kill-during-relaunch); attempt 2 runs clean.  Each
+# completed step appends "global_step repr(loss)" to losses.log.
+E2E_TRAINER = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Callback, CheckpointCallback, Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.resilience import FaultInjector, FaultSpec, install
+
+attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+resume = os.environ.get("PADDLE_ELASTIC_RESUME_DIR")
+KILLS = {0: 6, 1: 1}    # attempt -> hapi.train_step kill occurrence
+if attempt in KILLS:
+    install(FaultInjector([FaultSpec("hapi.train_step", "kill",
+                                     occurrence=KILLS[attempt])]))
+
+class Toy(Dataset):
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = rng.randint(0, 2, (n,)).astype(np.int64)
+        self.x = (rng.randn(n, 8) * 0.3 +
+                  self.y[:, None].astype(np.float32) * 2.0
+                  ).astype(np.float32)
+    def __len__(self):
+        return len(self.x)
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+class Rec(Callback):
+    def __init__(self, path):
+        super().__init__()
+        self.path = path
+        self.gstep = 0
+    def on_train_begin(self, logs=None):
+        info = getattr(self.model, "_resume_info", None) or {}
+        self.gstep = int(info.get("global_step", 0))
+    def on_train_batch_end(self, step, logs=None):
+        self.gstep += 1
+        with open(self.path, "a") as f:
+            f.write(f"{self.gstep} {logs['loss']!r}\\n")
+            f.flush()
+
+paddle.seed(3)
+net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+model = Model(net)
+opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                parameters=model.parameters())
+model.prepare(opt, nn.CrossEntropyLoss())
+cbs = [Rec(os.environ["E2E_LOSS_LOG"])]
+if resume:
+    cbs.append(CheckpointCallback(resume, every_n_steps=1))
+model.fit(Toy(), batch_size=16, epochs=2, shuffle=False, verbose=0,
+          callbacks=cbs, resume_from=resume)
+"""
+
+
+def _clean_env(**extra):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+def _read_losses(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            gstep, loss = line.split(" ", 1)
+            out[int(gstep)] = float(loss)
+    return out
+
+
+@pytest.mark.faultinject
+class TestSupervisorEndToEnd:
+    def test_killed_trainer_resumes_bitwise(self, tmp_path):
+        """Kill the trainer mid-epoch, then kill the recovery run too:
+        the supervisor relaunches both times with zero operator action
+        and the assembled loss curve equals an uninterrupted run's,
+        bitwise."""
+        script = tmp_path / "trainer.py"
+        script.write_text(E2E_TRAINER)
+
+        # uninterrupted reference in an identical subprocess environment
+        # (attempt 99 installs no faults; fresh checkpoint dir)
+        ref_log = tmp_path / "ref.log"
+        proc = subprocess.run(
+            [sys.executable, str(script)], cwd=REPO, timeout=300,
+            env=_clean_env(E2E_LOSS_LOG=str(ref_log),
+                           PADDLE_RESTART_ATTEMPT="99",
+                           PADDLE_ELASTIC_RESUME_DIR=str(
+                               tmp_path / "ck_ref")),
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        ref = _read_losses(ref_log)
+        assert sorted(ref) == list(range(1, 9))
+
+        # supervised run: attempt 0 killed at global step 6, attempt 1
+        # (the recovery run) killed at its first step, attempt 2 clean
+        loss_log = tmp_path / "sup.log"
+        ckdir = tmp_path / "ck"
+        sup = TrainingSupervisor(
+            [sys.executable, str(script)], checkpoint_dir=str(ckdir),
+            max_restarts=3, backoff_base=0.01, backoff_cap=0.05,
+            env=_clean_env(E2E_LOSS_LOG=str(loss_log)),
+            log_path=str(tmp_path / "sup_child.log"))
+        assert sup.run() == 0
+        assert [r for r, _ in sup.restarts] == ["crash", "crash"]
+
+        got = _read_losses(loss_log)
+        assert sorted(got) == list(range(1, 9))
+        np.testing.assert_array_equal(
+            np.asarray([got[s] for s in range(1, 9)]),
+            np.asarray([ref[s] for s in range(1, 9)]))
+        # the supervisor saw the resume point advance across attempts
+        assert sup._resume_step() == 8

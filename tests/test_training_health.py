@@ -291,6 +291,207 @@ class TestHealthMonitor:
         assert mon.healthy
 
 
+# ------------------------------------------------- health-triggered rollback
+
+
+class _Arrays(Dataset):
+    def __init__(self, x, y):
+        self.x, self.y = x, y
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _rollback_problem(bad_batches=(5,), batch=4, n=32):
+    """(poisoned dataset, reference dataset) — the reference simply has
+    the poisoned batches' samples removed, which is exactly what a
+    rollback + skipped-window run should be equivalent to."""
+    rng = np.random.RandomState(7)
+    y = rng.randint(0, 2, (n,)).astype(np.int64)
+    x = (rng.randn(n, 4) * 0.3 + y[:, None] * 2.0).astype(np.float32)
+    bad = x.copy()
+    keep = np.ones(n, bool)
+    for b in bad_batches:
+        bad[b * batch:(b + 1) * batch] = np.nan
+        keep[b * batch:(b + 1) * batch] = False
+    return _Arrays(bad, y), _Arrays(x[keep], y[keep])
+
+
+def _rb_model(seed=11):
+    paddle.seed(seed)
+    model = paddle.Model(nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                       nn.Linear(8, 2)))
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    return model
+
+
+class _Losses(paddle.hapi.Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(logs["loss"])
+
+
+def _rollback_count(reason):
+    from paddle_tpu.observability import default_registry
+
+    fam = default_registry().get("training_rollbacks_total")
+    return fam.labels(reason=reason).value if fam else 0
+
+
+class TestHealthRollback:
+    def test_nan_batch_rolls_back_once_and_skips_window(self, tmp_path):
+        """Acceptance: an injected-NaN batch triggers exactly one
+        rollback to the last good checkpoint
+        (training_rollbacks_total{reason="non_finite_loss"} == 1) and
+        the continued loss curve past the skipped window equals a run
+        that never saw the poisoned batch."""
+        from paddle_tpu.hapi import CheckpointCallback
+        from paddle_tpu.resilience import CheckpointManager
+
+        data, ref_data = _rollback_problem(bad_batches=(5,))
+        ref_rec = _Losses()
+        _rb_model().fit(ref_data, batch_size=4, epochs=1, shuffle=False,
+                        verbose=0,
+                        callbacks=[ref_rec,
+                                   HealthMonitor(action="gauge")])
+        assert len(ref_rec.losses) == 7
+
+        before = _rollback_count("non_finite_loss")
+        rec = _Losses()
+        mon = HealthMonitor(action="rollback")
+        ckdir = str(tmp_path / "ck")
+        _rb_model().fit(data, batch_size=4, epochs=1, shuffle=False,
+                        verbose=0,
+                        callbacks=[rec, mon,
+                                   CheckpointCallback(ckdir,
+                                                      every_n_steps=1)])
+        assert len(rec.losses) == 8
+        assert not np.isfinite(rec.losses[5])       # the poisoned step
+        assert _rollback_count("non_finite_loss") == before + 1
+        assert mon.rollbacks == 1
+        assert mon.healthy                           # recovered
+        # pre-window and post-window segments line up with the
+        # never-saw-that-batch reference, step for step
+        np.testing.assert_allclose(rec.losses[:5], ref_rec.losses[:5],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(rec.losses[6:], ref_rec.losses[5:],
+                                   rtol=1e-5, atol=1e-6)
+        # the skipped window is durable in the newest manifest
+        _, _, manifest = CheckpointManager(ckdir).restore()
+        windows = manifest["extra"]["skipped_windows"]
+        assert len(windows) == 1
+        w = windows[0]
+        assert w["reason"] == "non_finite_loss"
+        assert (w["first_step"], w["last_step"]) == (5, 5)
+        assert w["restored_global_step"] == 5
+        # the rollback left a supervisor::rollback span in the recorder
+        from paddle_tpu.observability import default_tracer
+
+        names = [t["name"] for t in default_tracer().traces()]
+        assert "supervisor::rollback" in names
+
+    @pytest.mark.faultinject
+    def test_kill_right_after_rollback_resumes_past_window(self,
+                                                           tmp_path):
+        """The skipped window is committed the instant the rollback
+        happens: a process killed immediately after must resume PAST
+        the poisoned batch — never replay it, never re-anomaly."""
+        from paddle_tpu.hapi import CheckpointCallback
+        from paddle_tpu.resilience import (CheckpointManager, FaultSpec,
+                                           SimulatedCrash,
+                                           injected_faults)
+
+        data, ref_data = _rollback_problem(bad_batches=(5,))
+        ref_rec = _Losses()
+        _rb_model().fit(ref_data, batch_size=4, epochs=1, shuffle=False,
+                        verbose=0,
+                        callbacks=[ref_rec,
+                                   HealthMonitor(action="gauge")])
+
+        ckdir = str(tmp_path / "ck")
+        rec_a = _Losses()
+        with injected_faults(FaultSpec("hapi.train_step", "kill",
+                                       occurrence=6)):
+            with pytest.raises(SimulatedCrash):
+                _rb_model().fit(
+                    data, batch_size=4, epochs=1, shuffle=False,
+                    verbose=0,
+                    callbacks=[rec_a, HealthMonitor(action="rollback"),
+                               CheckpointCallback(ckdir,
+                                                  every_n_steps=1)])
+        assert len(rec_a.losses) == 6       # killed at the bad step
+
+        rec_b = _Losses()
+        mon_b = HealthMonitor(action="rollback")
+        _rb_model(seed=99).fit(
+            data, batch_size=4, epochs=1, shuffle=False, verbose=0,
+            callbacks=[rec_b, mon_b,
+                       CheckpointCallback(ckdir, every_n_steps=1)],
+            resume_from=ckdir)
+        assert len(rec_b.losses) == 2       # batches 6 and 7 only
+        assert mon_b.events == []           # the bad batch never replayed
+        np.testing.assert_allclose(rec_b.losses, ref_rec.losses[5:],
+                                   rtol=1e-5, atol=1e-6)
+        # the window survives the relaunch's own manifests
+        _, _, manifest = CheckpointManager(ckdir).restore()
+        assert len(manifest["extra"]["skipped_windows"]) == 1
+
+    def test_rollback_without_checkpoint_callback_raises(self):
+        data, _ = _rollback_problem(bad_batches=(2,), n=16)
+        with pytest.raises(TrainingHealthError) as ei:
+            _rb_model().fit(data, batch_size=4, epochs=1, shuffle=False,
+                            verbose=0,
+                            callbacks=[HealthMonitor(action="rollback")])
+        assert ei.value.kind == "non_finite_loss"
+        assert "CheckpointCallback" in str(ei.value)
+
+    def test_max_rollbacks_escalates(self, tmp_path):
+        """Two poisoned batches with max_rollbacks=1: the first rolls
+        back, the second escalates — a run that keeps needing rewinds
+        must die loudly, not thrash forever."""
+        from paddle_tpu.hapi import CheckpointCallback
+
+        data, _ = _rollback_problem(bad_batches=(2, 5))
+        mon = HealthMonitor(action="rollback", max_rollbacks=1)
+        with pytest.raises(TrainingHealthError):
+            _rb_model().fit(
+                data, batch_size=4, epochs=1, shuffle=False, verbose=0,
+                callbacks=[mon,
+                           CheckpointCallback(str(tmp_path / "ck"),
+                                              every_n_steps=1)])
+        assert mon.rollbacks == 2
+
+    def test_grad_spike_requests_rollback(self):
+        """Unit: a grad-norm outlier under action='rollback' files a
+        rollback request on the model (the fit loop executes it)."""
+        class Stub:
+            _rollback_request = None
+
+        mon = HealthMonitor(action="rollback", min_samples=5, window=20,
+                            registry=MetricsRegistry(), tracer=Tracer(),
+                            clock=ManualClock())
+        mon.set_model(Stub())
+        mon.on_train_begin()
+        rng = np.random.RandomState(0)
+        for i in range(15):
+            mon.on_train_batch_begin(i)
+            mon.on_train_batch_end(
+                i, {"loss": 1.0, "grad_norm": 1.0 + 0.05 * rng.randn()})
+        mon.on_train_batch_begin(15)
+        mon.on_train_batch_end(15, {"loss": 1.0, "grad_norm": 50.0})
+        req = mon.model._rollback_request
+        assert req is not None and req["reason"] == "grad_spike"
+        assert mon.rollbacks == 1
+
+
 # ------------------------------------------------------ cross-rank merge
 
 

@@ -39,6 +39,10 @@ ALLOWLIST = {
     # chrome-trace export: an append-style log artifact, not durable
     # state; a torn trace is re-recordable
     "profiler/profiler.py",
+    # supervisor child logs: append-style run transcripts (same class
+    # as trace exports) — a torn log line is cosmetic, and the file
+    # must be open BEFORE the child exists to capture its first bytes
+    "resilience/supervisor.py",
 }
 
 
