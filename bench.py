@@ -292,12 +292,83 @@ def bench_resnet(batch=32, steps=5):
                          "compiler": f"jax {jax.__version__}"}}
 
 
+def _long_prompt_interference(cfg, params, *, chunk_len, long_len,
+                              n_decode=3, n_late=2, max_new=8, seed=0):
+    """One long prompt arriving into a saturated decode batch.
+
+    Runs the unified-step engine at the given ``chunk_len`` and measures
+    what the long prompt's prefill does to everyone else:
+
+    - ``decode_stall_ms`` — the worst step wall time while the long
+      prompt is mid-prefill.  Decode rows emit one token per step, so
+      this IS the worst inter-token gap a decoding request saw.
+    - ``ttft_late_*`` — TTFT of short requests submitted right behind
+      the long prompt (they must share steps with its chunks).
+
+    ``chunk_len == long_len`` emulates the old phase-split scheduler:
+    the whole prompt runs as one mega-row, stalling the batch for the
+    full prompt length — the head-of-line blocking chunked prefill
+    removes."""
+    from paddle_tpu.serving import Engine, SamplingParams
+
+    rng = np.random.RandomState(seed)
+    eng = Engine(cfg, params, page_size=16, num_pages=256,
+                 max_batch_size=n_decode + n_late + 1, chunk_len=chunk_len)
+    # compile the unified step before the clock starts
+    eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=2))
+
+    def prompt(n):
+        return rng.randint(0, cfg.vocab_size, n).tolist()
+
+    # saturate: n_decode requests decoding steadily
+    deco = [eng.add_request(prompt(8), SamplingParams(
+        max_new_tokens=long_len // max(1, chunk_len) * 4 + 32))
+        for _ in range(n_decode)]
+    for _ in range(3):
+        eng.step()
+    assert all(r.prompt_pos == len(r.prompt) for r in deco)
+
+    long_r = eng.add_request(prompt(long_len),
+                             SamplingParams(max_new_tokens=max_new))
+    # the late shorts "arrive" now — while the long prompt's first
+    # prefill step is about to be in flight.  They can only be submitted
+    # at the next step boundary, so measuring their TTFT from t_arrive
+    # charges them the in-flight step they had to wait out (the whole
+    # prompt under phase-split, one bounded chunk under chunked prefill)
+    t_arrive = time.perf_counter()
+    late = []
+    stall, prefill_steps = 0.0, 0
+    while eng.has_work():
+        pos_before = long_r.prompt_pos
+        t0 = time.perf_counter()
+        eng.step()
+        dt = time.perf_counter() - t0
+        if not late:
+            late = [eng.add_request(prompt(8),
+                                    SamplingParams(max_new_tokens=4))
+                    for _ in range(n_late)]
+        if long_r.prompt_pos > pos_before:   # this step ran prompt chunks
+            prefill_steps += 1
+            stall = max(stall, dt)
+    ttft_late = [r.t_first_token - t_arrive for r in late
+                 if r.t_first_token is not None]
+    return {
+        "chunk_len": chunk_len,
+        "decode_stall_ms": stall * 1e3,
+        "prefill_steps": prefill_steps,
+        "ttft_long_ms": (long_r.t_first_token - long_r.t_submit) * 1e3,
+        "ttft_late_p95_ms": float(np.percentile(ttft_late, 95)) * 1e3
+        if ttft_late else None,
+    }
+
+
 def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
     """Serving scenario: the continuous-batching engine under a synthetic
     Poisson arrival trace (open-loop — arrival times don't wait on the
     engine, so queueing shows up in TTFT exactly as live traffic would).
-    Reports generated tokens/sec, TTFT/queue-wait percentiles and
-    page-pool occupancy."""
+    Reports generated tokens/sec, TTFT/queue-wait percentiles, page-pool
+    occupancy, and the long-prompt-interference trace (chunked prefill
+    vs an emulated phase-split baseline)."""
     import dataclasses
 
     import jax
@@ -311,7 +382,7 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
     params = gpt_init(cfg, jax.random.key(0))
     eng = Engine(cfg, params, page_size=16,
                  num_pages=2048 if on_tpu else 512, max_batch_size=8,
-                 prefill_len=min(128, cfg.max_seq_len),
+                 chunk_len=min(32, cfg.max_seq_len),
                  # production posture: shed at 95% pool / deep queue
                  # rather than letting TTFT collapse for everyone
                  shed_occupancy_high=0.95, shed_queue_high=4 * n_requests)
@@ -362,6 +433,7 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
         "shed": snap["requests"]["shed"],
         "deadline_evicted": snap["requests"]["deadline_evicted"],
         "engine_healthy": snap["engine_healthy"],
+        "prefill_chunks": snap["tokens"]["prefill_chunks"],
     }
     log(f"[serving] {out['tokens_per_sec']:.1f} tok/s, TTFT p50 "
         f"{out['ttft_ms_p50'] or 0:.0f}ms p95 "
@@ -369,6 +441,41 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
         f"pool peak {out['page_occupancy_peak']*100:.0f}%, "
         f"shed {out['shed']}, deadline-evicted {out['deadline_evicted']}, "
         f"{'healthy' if out['engine_healthy'] else 'degraded'}")
+
+    # head-of-line blocking probe: one long prompt into a saturated
+    # decode batch, chunked prefill vs the emulated phase-split baseline.
+    # The probe engines deliberately use different static shapes, which
+    # would read as recompiles of the main engine's program — keep their
+    # compiles out of this section's watchdog telemetry.
+    from paddle_tpu.observability.compile_watchdog import default_watchdog
+
+    probe_max_new = 8
+    long_len = min(2048, cfg.max_seq_len - 4 * probe_max_new)
+    probe_chunk = max(16, min(32, long_len // 8))
+    wd = default_watchdog()
+    wd_prev, wd.enabled = wd.enabled, False
+    try:
+        chunked = _long_prompt_interference(
+            cfg, params, chunk_len=probe_chunk, long_len=long_len,
+            max_new=probe_max_new, seed=seed)
+        split = _long_prompt_interference(
+            cfg, params, chunk_len=long_len, long_len=long_len,
+            max_new=probe_max_new, seed=seed)
+    finally:
+        wd.enabled = wd_prev
+    out["long_prompt_interference"] = {
+        "long_prompt_tokens": long_len,
+        "chunked": chunked,
+        "phase_split_emulated": split,
+        "decode_stall_ratio": (split["decode_stall_ms"]
+                               / max(chunked["decode_stall_ms"], 1e-9)),
+    }
+    log(f"[serving] long-prompt interference ({long_len} tok): decode "
+        f"stall {chunked['decode_stall_ms']:.1f}ms chunked vs "
+        f"{split['decode_stall_ms']:.1f}ms phase-split "
+        f"({out['long_prompt_interference']['decode_stall_ratio']:.1f}x), "
+        f"late TTFT p95 {chunked['ttft_late_p95_ms'] or 0:.0f}ms vs "
+        f"{split['ttft_late_p95_ms'] or 0:.0f}ms")
     return out
 
 

@@ -89,19 +89,22 @@ class Config:
 
     # ---- autoregressive generation (serving engine) ----
     def enable_generation(self, model_config, params=None, *, page_size=16,
-                          num_pages=256, max_batch_size=4,
+                          num_pages=256, max_batch_size=4, chunk_len=None,
                           prefill_len=None):
         """Switch create_predictor to a GenerationPredictor: a
         continuous-batching, paged-KV-cache generation engine
         (paddle_tpu.serving) over the given GPTConfig.  params defaults
         to fresh gpt_init weights; page_size/num_pages size the KV page
-        pool, max_batch_size the in-flight decode batch, prefill_len the
-        static prompt pad length."""
+        pool, max_batch_size the in-flight batch.  chunk_len bounds the
+        prompt tokens any request contributes to one unified step
+        (chunked prefill — prompts of any admissible length are split
+        into chunk_len-token rows scheduled next to decode rows;
+        prefill_len is the accepted legacy alias)."""
         self.generation = {
             "config": model_config, "params": params,
             "knobs": {"page_size": page_size, "num_pages": num_pages,
                       "max_batch_size": max_batch_size,
-                      "prefill_len": prefill_len},
+                      "chunk_len": chunk_len, "prefill_len": prefill_len},
         }
         return self
 

@@ -1,30 +1,47 @@
-"""Paged-attention decode kernel — ragged single-token attention over a
+"""Ragged paged attention — one fused prefill+decode kernel over a
 block-paged KV cache.
 
 The serving engine (paddle_tpu/serving) stores K/V in fixed-size pages so
 sequences of very different lengths share one physical pool without
 padding ("Ragged Paged Attention", arXiv:2604.15464 — the TPU analog of
-vLLM's PagedAttention).  At decode each sequence contributes ONE query
-token; its keys/values live scattered across the pages named by its page
-table.  This kernel gathers those pages and masks by the per-sequence
-length, so a ragged batch runs as one static-shape program.
+vLLM's PagedAttention).  Each batch row is at an *arbitrary* point in its
+life: a mid-prefill prompt chunk of ``query_len`` tokens, or a decode
+step (the degenerate ``query_len == 1`` chunk).  One kernel serves both,
+which is what lets the engine schedule prompt chunks as ordinary rows
+next to decoding rows instead of running prefill as a separate
+batch-stalling pass.
+
+Row semantics: row ``b`` contributes ``query_lens[b]`` query tokens whose
+keys/values have just been appended to its pages, so its chunk occupies
+absolute positions ``context_lens[b] - query_lens[b] ..
+context_lens[b] - 1``.  Query token ``t`` attends causally to every kv
+position ``<= context_lens[b] - query_lens[b] + t``.  ``query_lens[b] ==
+0`` marks an idle row (output zeros).
 
 Two implementations with one contract:
 
-- ``_paged_attention_ref`` — pure-jnp gather + fp32 softmax.  Serves CPU
+- ``_ragged_attention_ref`` — pure-jnp gather + fp32 softmax.  Serves CPU
   tests and is the numerics oracle.
-- the Pallas kernel — grid (batch, pages_per_seq); the page table and
-  sequence lengths ride in scalar-prefetch (PrefetchScalarGridSpec) so
-  the BlockSpec index_map DMAs exactly the pages each sequence owns.
-  Page steps are the innermost (sequential) grid axis; VMEM scratch
-  carries the online-softmax state across them, flash-attention style.
+- the Pallas kernel — grid (batch, pages_per_seq); the page table and the
+  two length vectors ride in scalar-prefetch (PrefetchScalarGridSpec) so
+  the BlockSpec index_map DMAs exactly the pages each row owns.  Page
+  steps are the innermost (sequential) grid axis; VMEM scratch carries
+  the online-softmax state (per query token × head) across them,
+  flash-attention style, with the causal mask applied relative to each
+  row's context offset.
 
 Layouts:
-  q            [B, H, hd]           one query token per sequence
+  q            [B, Q, H, hd]        Q = max query tokens per row, padded
   k/v_pages    [P, page_size, H, hd] the shared page pool (one layer)
   page_tables  [B, max_pages] int32  physical page id per logical page
-  seq_lens     [B] int32             valid kv tokens (0 = inactive slot)
-Returns [B, H, hd] in q.dtype; inactive slots (seq_len 0) return zeros.
+  query_lens   [B] int32             valid query tokens (0 = idle row)
+  context_lens [B] int32             kv tokens incl. this chunk
+Returns [B, Q, H, hd] in q.dtype; padded query slots and idle rows
+return zeros.
+
+``paged_attention`` (the original decode-only entry: one query token per
+row, ``seq_lens`` masking) is kept as the Q == 1 degenerate case of the
+same kernel.
 """
 from __future__ import annotations
 
@@ -44,7 +61,8 @@ try:
 except Exception:  # pragma: no cover
     _PALLAS_OK = False
 
-__all__ = ["paged_attention", "paged_attention_available"]
+__all__ = ["paged_attention", "ragged_paged_attention",
+           "paged_attention_available"]
 
 _NEG_INF = -1e30
 
@@ -63,31 +81,40 @@ def paged_attention_available():
 # ---------------------------------------------------------------- reference
 
 
-def _paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens, scale):
-    """Gather-then-mask oracle: [B, max_kv] dense view of the pages."""
-    B = q.shape[0]
-    _, page_size, H, hd = k_pages.shape
+def _ragged_attention_ref(q, k_pages, v_pages, page_tables, query_lens,
+                          context_lens, scale):
+    """Gather-then-mask oracle: [B, max_kv] dense view of the pages with
+    the per-row causal mask applied at each query token's absolute
+    position."""
+    B, Q, H, hd = q.shape
+    _, page_size, _, _ = k_pages.shape
     max_pages = page_tables.shape[1]
     k = jnp.take(k_pages, page_tables, axis=0)      # [B, M, ps, H, hd]
     v = jnp.take(v_pages, page_tables, axis=0)
     k = k.reshape(B, max_pages * page_size, H, hd)
     v = v.reshape(B, max_pages * page_size, H, hd)
-    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+    s = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     t = jnp.arange(max_pages * page_size)
-    s = jnp.where(t[None, None, :] < seq_lens[:, None, None], s, _NEG_INF)
-    # fp32 softmax; a fully-masked row (inactive slot) yields uniform junk —
-    # zero it below rather than divide by 0
+    tq = jnp.arange(Q)
+    # query token tq of row b sits at absolute position ctx - q_len + tq
+    pos = (context_lens - query_lens)[:, None] + tq[None, :]       # [B, Q]
+    ok = ((t[None, None, :] <= pos[:, :, None])
+          & (tq[None, :, None] < query_lens[:, None, None]))
+    s = jnp.where(ok[:, None], s, _NEG_INF)
+    # fp32 softmax; fully-masked rows (padded query slots / idle rows)
+    # yield uniform junk — zeroed below rather than divided by 0
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
-    out = jnp.where((seq_lens > 0)[:, None, None], out, 0.0)
+    out = jnp.einsum("bhqt,bthd->bqhd", p, v.astype(jnp.float32))
+    out = jnp.where((tq[None, :] < query_lens[:, None])[:, :, None, None],
+                    out, 0.0)
     return out.astype(q.dtype)
 
 
 # ------------------------------------------------------------------- kernel
 
 
-def _decode_kernel(tbl_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
+def _ragged_kernel(tbl_ref, qlen_ref, ctx_ref, q_ref, kp_ref, vp_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, scale, page_size, num_pages):
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -98,77 +125,132 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    seq_len = len_ref[b]
+    q_len = qlen_ref[b]
+    ctx = ctx_ref[b]
     start = j * page_size
 
-    @pl.when(start < seq_len)
+    @pl.when((start < ctx) & (q_len > 0))
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # [H, hd]
+        q = q_ref[0].astype(jnp.float32)            # [Q, H, hd]
         k = kp_ref[0].astype(jnp.float32)           # [ps, H, hd]
         v = vp_ref[0].astype(jnp.float32)
-        # s[h, t] = q[h, :] . k[t, h, :]  (batch over heads)
+        Q = q.shape[0]
+        # s[h, tq, t] = q[tq, h, :] . k[t, h, :]  (batch over heads)
         s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale  # [H, ps]
-        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-        s = jnp.where(pos < seq_len, s, _NEG_INF)
-        m_prev = m_ref[:]                            # [H, 1]
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [H, Q, ps]
+        tq = jax.lax.broadcasted_iota(jnp.int32, (1, Q, page_size), 1)
+        kv = start + jax.lax.broadcasted_iota(jnp.int32, (1, Q, page_size),
+                                              2)
+        # causal relative to the row's context offset: query tq sits at
+        # absolute position ctx - q_len + tq
+        ok = (kv <= ctx - q_len + tq) & (tq < q_len)
+        s = jnp.where(ok, s, _NEG_INF)
+        m_prev = m_ref[:]                            # [H, Q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                       # [H, ps]
+        p = jnp.exp(s - m_new)                       # [H, Q, ps]
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        # acc[h, d] += p[h, :] . v[:, h, d]
+        # acc[h, tq, d] += p[h, tq, :] . v[:, h, d]
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((0,), (1,))),
+            p, v, (((2,), (0,)), ((0,), (1,))),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
     @pl.when(j == num_pages - 1)
     def _final():
+        Q = acc_ref.shape[1]
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        o = acc_ref[:] / l_safe                      # [H, Q, hd]
+        # padded query slots accumulated garbage behind the mask with
+        # m == -inf; zero them so the kernel matches the ref everywhere
+        tq = jax.lax.broadcasted_iota(jnp.int32, (1, Q, 1), 1)
+        o = jnp.where(tq < q_len, o, 0.0)
+        o_ref[0] = o.transpose(1, 0, 2).astype(o_ref.dtype)
 
 
-def _paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
-                            scale, interpret):
-    B, H, hd = q.shape
+def _ragged_attention_kernel(q, k_pages, v_pages, page_tables, query_lens,
+                             context_lens, scale, interpret):
+    B, Q, H, hd = q.shape
     _, page_size, _, _ = k_pages.shape
     max_pages = page_tables.shape[1]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, max_pages),
         in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+            pl.BlockSpec((1, Q, H, hd),
+                         lambda b, j, tbl, ql, cl: (b, 0, 0, 0)),
             pl.BlockSpec((1, page_size, H, hd),
-                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
+                         lambda b, j, tbl, ql, cl: (tbl[b, j], 0, 0, 0)),
             pl.BlockSpec((1, page_size, H, hd),
-                         lambda b, j, tbl, ln: (tbl[b, j], 0, 0, 0)),
+                         lambda b, j, tbl, ql, cl: (tbl[b, j], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, H, hd), lambda b, j, tbl, ln: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, Q, H, hd),
+                               lambda b, j, tbl, ql, cl: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H, hd), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, Q, hd), jnp.float32),
+            pltpu.VMEM((H, Q, 1), jnp.float32),
+            pltpu.VMEM((H, Q, 1), jnp.float32),
         ],
     )
-    kernel = functools.partial(_decode_kernel, scale=scale,
+    kernel = functools.partial(_ragged_kernel, scale=scale,
                                page_size=page_size, num_pages=max_pages)
     return pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Q, H, hd), q.dtype),
         interpret=interpret,
-    )(page_tables, seq_lens, q, k_pages, v_pages)
+    )(page_tables, query_lens, context_lens, q, k_pages, v_pages)
 
 
 # -------------------------------------------------------------- public API
 
 
-def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, scale=None):
-    """Single-token decode attention over a paged KV cache (see module
+def ragged_paged_attention(q, k_pages, v_pages, page_tables, query_lens,
+                           context_lens, scale=None):
+    """Fused prefill+decode attention over a paged KV cache (see module
     docstring for layouts).  Routes to the Pallas kernel on TPU; the jnp
     gather path elsewhere (identical contract, fp32 softmax in both)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    page_tables = page_tables.astype(jnp.int32)
+    query_lens = query_lens.astype(jnp.int32)
+    context_lens = context_lens.astype(jnp.int32)
+    if _PALLAS_OK and (_on_tpu() or flag("tpu_interpret_pallas")):
+        return _ragged_attention_kernel(q, k_pages, v_pages, page_tables,
+                                        query_lens, context_lens, scale,
+                                        interpret=not _on_tpu())
+    return _ragged_attention_ref(q, k_pages, v_pages, page_tables,
+                                 query_lens, context_lens, scale)
+
+
+# ------------------------------------------- decode (Q == 1) degenerate
+
+
+def _paged_attention_ref(q, k_pages, v_pages, page_tables, seq_lens, scale):
+    """Decode oracle: one query per row — the q_len == 1 row of the
+    ragged reference (seq_len 0 marks an inactive slot)."""
+    seq_lens = seq_lens.astype(jnp.int32)
+    qlens = (seq_lens > 0).astype(jnp.int32)
+    return _ragged_attention_ref(q[:, None], k_pages, v_pages, page_tables,
+                                 qlens, seq_lens, scale)[:, 0]
+
+
+def _paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
+                            scale, interpret):
+    seq_lens = seq_lens.astype(jnp.int32)
+    qlens = (seq_lens > 0).astype(jnp.int32)
+    return _ragged_attention_kernel(q[:, None], k_pages, v_pages,
+                                    page_tables, qlens, seq_lens, scale,
+                                    interpret)[:, 0]
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens, scale=None):
+    """Single-token decode attention over a paged KV cache: q [B, H, hd],
+    one query token per sequence attending over its first ``seq_lens``
+    kv tokens — the query_len == 1 degenerate row of the ragged kernel,
+    kept as a stable API for decode-only callers and tests."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     page_tables = page_tables.astype(jnp.int32)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["GPTConfig", "gpt_init", "gpt_forward", "gpt_loss",
-           "gpt_param_specs", "gpt_prefill", "gpt_decode_step", "GPT",
+           "gpt_param_specs", "gpt_ragged_step", "GPT",
            "GPT_CONFIGS"]
 
 
@@ -315,104 +315,100 @@ def gpt_loss(cfg: GPTConfig, params, tokens, labels=None, dropout_key=None):
     return ce
 
 
-# -------------------------------------------------- KV-cache decode path
+# ----------------------------------------------- KV-cache ragged step
 #
 # The serving engine (paddle_tpu/serving) generates autoregressively with a
-# block-paged KV cache instead of full-sequence recompute.  Two entry
-# points, each with STATIC shapes so each compiles exactly once:
+# block-paged KV cache instead of full-sequence recompute.  ONE entry
+# point with STATIC shapes, so the whole engine compiles exactly once:
 #
-#   gpt_prefill     — run the full prompt (padded to a fixed length) with
-#                     the training attention path and scatter every
-#                     layer's K/V into the cache pages; returns the
-#                     next-token logits at each sequence's last position.
-#   gpt_decode_step — one token per sequence: append its K/V to the pages
-#                     and attend over the pages via the paged-attention
-#                     kernel (ragged lengths, masked per sequence).
+#   gpt_ragged_step — a packed batch of query tokens where every row is
+#                     at an arbitrary point in its life: a mid-prefill
+#                     prompt chunk, or a decode step (the query_len == 1
+#                     chunk).  Appends each token's K/V to the pages and
+#                     attends via the ragged paged-attention kernel.
 #
-# Pages are stacked [L, P, page_size, H, hd] so the layer loop stays a
-# lax.scan (pages ride as per-layer xs/ys), mirroring gpt_forward.
+# This is what kills the prefill/decode phase split: a prompt is N
+# bounded-size chunk rows interleaved with decode rows, not one
+# batch-stalling full-sequence pass.  Pages are stacked
+# [L, P, page_size, H, hd] so the layer loop stays a lax.scan (pages
+# ride as per-layer xs/ys), mirroring gpt_forward.
 
 
 def _paged_write(pages, page_idx, slot_idx, vals):
-    """Scatter vals [B, ..., H, hd] into pages [P, ps, H, hd] at
+    """Scatter vals [..., H, hd] into pages [P, ps, H, hd] at
     (page_idx, slot_idx); indices already routed out-of-bounds for
     masked-out positions, which mode="drop" discards."""
     return pages.at[page_idx, slot_idx].set(vals.astype(pages.dtype),
                                             mode="drop")
 
 
-def gpt_prefill(cfg: GPTConfig, params, tokens, seq_lens, k_pages, v_pages,
-                page_tables):
-    """Prompt pass: tokens [B, S] (right-padded; valid lengths seq_lens
-    [B]), pages [L, P, ps, H, hd], page_tables [B, max_pages].  Returns
-    (logits [B, V] at each sequence's last valid position, k_pages,
-    v_pages).  The attention math is gpt_forward's (causal, flash when
-    available), so positions < seq_len are unaffected by padding."""
-    B, S = tokens.shape
-    P = k_pages.shape[1]
-    page_size = k_pages.shape[2]
-    x = jnp.take(params["wte"], tokens, axis=0) + params["wpe"][:S]
-    x = x.astype(cfg.jdtype())
+def gpt_ragged_step(cfg: GPTConfig, params, tokens, row_of_token,
+                    slot_of_token, query_lens, context_lens, k_pages,
+                    v_pages, page_tables, *, max_q=None):
+    """Unified ragged step over the paged KV cache — the serving
+    engine's single jitted program for both prompt chunks and decode.
 
-    pos = jnp.arange(S)
-    page_idx = jnp.take(page_tables, pos // page_size, axis=1)     # [B, S]
-    slot_idx = jnp.broadcast_to((pos % page_size)[None, :], (B, S))
-    valid = pos[None, :] < seq_lens[:, None]
-    safe_page = jnp.where(valid, page_idx, P)          # OOB => dropped
+    Packing contract: ``tokens`` [T] holds every scheduled query token,
+    row-major (row b's ``query_lens[b]`` tokens are contiguous and in
+    order; rows are packed in ascending batch-slot order).
+    ``row_of_token`` [T] names each token's batch row (== B for padding
+    slots, which are dropped everywhere); ``slot_of_token`` [T] is the
+    token's index within its row's chunk.  ``context_lens`` [B] counts
+    the row's total tokens *including* this chunk, so token t of row b
+    sits at absolute position ``context_lens[b] - query_lens[b] + t``.
+    ``max_q`` (static) bounds any single row's chunk — the padded query
+    width handed to the attention kernel.
 
-    def body(x, xs):
-        bp, kp, vp = xs
-        x, _, k, v = gpt_block(cfg, bp, x, return_kv=True)
-        kp = _paged_write(kp, safe_page, slot_idx, k)
-        vp = _paged_write(vp, safe_page, slot_idx, v)
-        return x, (kp, vp)
-
-    x, (k_pages, v_pages) = jax.lax.scan(
-        body, x, (params["blocks"], k_pages, v_pages))
-    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-    last = x[jnp.arange(B), jnp.maximum(seq_lens - 1, 0)]          # [B, D]
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("bd,vd->bv", last, params["wte"])
-    else:
-        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"])
-    return logits, k_pages, v_pages
-
-
-def gpt_decode_step(cfg: GPTConfig, params, tokens, positions, seq_lens,
-                    k_pages, v_pages, page_tables):
-    """One decode step: tokens [B] (the last sampled token per sequence),
-    positions [B] (its 0-based position), seq_lens [B] = positions + 1
-    for active slots and 0 for inactive ones (inactive slots write
-    nothing and return garbage logits the engine ignores).  Returns
-    (logits [B, V], k_pages, v_pages)."""
-    B = tokens.shape[0]
+    Compute is flat [T, D] (a decode row costs one token, not a padded
+    chunk); only the attention kernel sees a per-row padded [B, max_q]
+    view, scattered/gathered around the call.  Returns (logits [B, V]
+    at each row's last packed token — the next-token distribution for a
+    decode row or a prompt-completing chunk; rows with query_len 0
+    return garbage the engine ignores — k_pages, v_pages)."""
+    T = tokens.shape[0]
+    B = query_lens.shape[0]
     H, hd, D = cfg.num_heads, cfg.head_dim, cfg.hidden
     P = k_pages.shape[1]
     page_size = k_pages.shape[2]
+    Q = max_q or T
+
+    row_c = jnp.minimum(row_of_token, B - 1)
+    valid = ((row_of_token < B)
+             & (slot_of_token < jnp.take(query_lens, row_c)))
+    pos = jnp.clip(jnp.take(context_lens - query_lens, row_c)
+                   + slot_of_token, 0, cfg.max_seq_len - 1)        # [T]
 
     x = jnp.take(params["wte"], tokens, axis=0) + \
-        jnp.take(params["wpe"], positions, axis=0)
-    x = x.astype(cfg.jdtype())                                     # [B, D]
+        jnp.take(params["wpe"], pos, axis=0)
+    x = x.astype(cfg.jdtype())                                     # [T, D]
 
-    active = seq_lens > 0
     page_of_pos = jnp.take_along_axis(
-        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
-    safe_page = jnp.where(active, page_of_pos, P)
-    slot_idx = positions % page_size
+        jnp.take(page_tables, row_c, axis=0),
+        (pos // page_size)[:, None], axis=1)[:, 0]
+    safe_page = jnp.where(valid, page_of_pos, P)       # OOB => dropped
+    slot_in_page = pos % page_size
+    scat_row = jnp.where(valid, row_c, B)              # OOB => dropped
+    scat_slot = jnp.minimum(slot_of_token, Q - 1)
 
-    from ..kernels.paged_attention import paged_attention
+    from ..kernels.paged_attention import ragged_paged_attention
 
     def body(x, xs):
         bp, kp, vp = xs
         h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
-        qkv = jnp.einsum("bd,de->be", h, bp["qkv_w"]) + bp["qkv_b"]
-        qkv = qkv.reshape(B, H, 3, hd)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]     # [B, H, hd]
-        kp = _paged_write(kp, safe_page, slot_idx, k)
-        vp = _paged_write(vp, safe_page, slot_idx, v)
-        attn = paged_attention(q, kp, vp, page_tables, seq_lens)
-        attn = attn.reshape(B, D).astype(x.dtype)
-        x = x + jnp.einsum("bd,de->be", attn, bp["proj_w"]) + bp["proj_b"]
+        qkv = jnp.einsum("td,de->te", h, bp["qkv_w"]) + bp["qkv_b"]
+        qkv = qkv.reshape(T, H, 3, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [T, H, hd]
+        kp = _paged_write(kp, safe_page, slot_in_page, k)
+        vp = _paged_write(vp, safe_page, slot_in_page, v)
+        # the kernel wants per-row padded queries; scatter the packed
+        # tokens out, gather the outputs back flat (padding slots read
+        # zeros/junk that never reaches pages or logits)
+        q_pad = jnp.zeros((B, Q, H, hd), q.dtype) \
+            .at[scat_row, scat_slot].set(q, mode="drop")
+        attn = ragged_paged_attention(q_pad, kp, vp, page_tables,
+                                      query_lens, context_lens)
+        attn = attn[row_c, scat_slot].reshape(T, D).astype(x.dtype)
+        x = x + jnp.einsum("td,de->te", attn, bp["proj_w"]) + bp["proj_b"]
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
         if cfg.moe_experts:
@@ -422,21 +418,24 @@ def gpt_decode_step(cfg: GPTConfig, params, tokens, positions, seq_lens,
                 {"gate_w": bp["gate_w"], "up_w": bp["up_w"],
                  "up_b": bp["up_b"], "down_w": bp["down_w"],
                  "down_b": bp["down_b"]},
-                h[:, None, :], top_k=cfg.moe_top_k,
+                h[None], top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor)
-            return x + y[:, 0], (kp, vp)
-        h = jnp.einsum("bd,df->bf", h, bp["up_w"]) + bp["up_b"]
+            return x + y[0], (kp, vp)
+        h = jnp.einsum("td,df->tf", h, bp["up_w"]) + bp["up_b"]
         h = jax.nn.gelu(h, approximate=True)
-        h = jnp.einsum("bf,fd->bd", h, bp["down_w"]) + bp["down_b"]
+        h = jnp.einsum("tf,fd->td", h, bp["down_w"]) + bp["down_b"]
         return x + h, (kp, vp)
 
     x, (k_pages, v_pages) = jax.lax.scan(
         body, x, (params["blocks"], k_pages, v_pages))
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    # row b's last packed token sits at cumsum(query_lens)[b] - 1
+    last = jnp.clip(jnp.cumsum(query_lens) - 1, 0, T - 1)
+    x_last = jnp.take(x, last, axis=0)                             # [B, D]
     if cfg.tie_embeddings:
-        logits = jnp.einsum("bd,vd->bv", x, params["wte"])
+        logits = jnp.einsum("bd,vd->bv", x_last, params["wte"])
     else:
-        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"])
+        logits = jnp.einsum("bd,dv->bv", x_last, params["lm_head"])
     return logits, k_pages, v_pages
 
 

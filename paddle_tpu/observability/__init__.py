@@ -8,7 +8,7 @@ platform/monitor.h grown into a production observability stack):
   ``snapshot()`` and Prometheus text exposition.  ``serving.metrics``
   is a thin client; bench embeds the snapshot in every section's JSON.
 - :mod:`.compile_watchdog` — opt-in wrapper around the repo's
-  ``jax.jit`` entry points (hapi train step, serving prefill/decode,
+  ``jax.jit`` entry points (hapi train step, the serving unified step,
   hybrid-engine step, inference predictors, jit.to_static): counts
   compilations, records compile wall-time + HLO cost analysis, and
   WARNs with the argument shape/dtype diff on post-warmup recompiles —
@@ -16,7 +16,7 @@ platform/monitor.h grown into a production observability stack):
 - :mod:`.tracing` — the flight recorder: a thread-safe
   :class:`Span`/:class:`Tracer` model with a bounded ring of completed
   traces.  The serving engine records every request's lifecycle
-  (``queued → prefill → decode[i] → finished|evicted|shed``) and hapi
+  (``queued → chunk[i] → decode[i] → finished|evicted|shed``) and hapi
   ``Model.fit`` opens a per-step span, so training and serving share
   one timeline vocabulary; traces export as chrome-trace tracks or
   JSON.

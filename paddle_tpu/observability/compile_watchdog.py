@@ -4,7 +4,7 @@ Unintended XLA recompilation is the silent TPU throughput killer: one
 ragged batch (a tail batch, an un-padded prompt, a dtype drift) and a
 "compiles once" step quietly compiles every call.  The watchdog wraps
 the repo's ``jax.jit`` entry points (hapi ``_build_jit_step``, the
-inference predictors, the serving engine's prefill/decode, the hybrid
+inference predictors, the serving engine's unified step, the hybrid
 engine's train step, jit.to_static) and
 
 - counts compilations and calls per function (labelled counters

@@ -3,7 +3,7 @@
 Metrics (histograms, counters) answer "how slow is the p95"; they cannot
 answer "why was *this* request slow".  A :class:`Tracer` records one
 bounded-memory timeline per logical operation — a serving request's full
-lifecycle (``queued → admitted → prefill → decode[i] →
+lifecycle (``queued → admitted → chunk[i] → decode[i] →
 finished|evicted|shed``), a training step — as a tree of :class:`Span`\\ s
 sharing a ``trace_id``.  Design points:
 

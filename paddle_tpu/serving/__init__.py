@@ -1,14 +1,40 @@
-"""paddle_tpu.serving — continuous-batching LLM serving on TPU.
+"""paddle_tpu.serving — continuous-batching LLM serving on TPU with a
+unified-step (chunked prefill) scheduler.
 
 The production tail of the inference stack (the reference grew
 paddle/fluid/inference the same way): a paged KV cache
 (:mod:`kv_cache`), a continuous-batching scheduler (:mod:`engine`) over
-the paged-attention decode kernel (kernels/paged_attention.py), and the
-serving facade over the framework-wide metrics registry
-(:mod:`metrics` → paddle_tpu.observability).  ``inference.Config
-.enable_generation()`` + ``create_predictor`` expose it through the
-predictor API; ``bench.py --section serving`` measures tokens/sec and
-TTFT under a Poisson arrival trace.
+the fused ragged paged-attention kernel
+(kernels/paged_attention.py), and the serving facade over the
+framework-wide metrics registry (:mod:`metrics` →
+paddle_tpu.observability).  ``inference.Config.enable_generation()`` +
+``create_predictor`` expose it through the predictor API; ``bench.py
+--section serving`` measures tokens/sec, TTFT under a Poisson arrival
+trace, and the long-prompt-interference probe.
+
+Unified-step scheduling (this replaced the prefill/decode phase split):
+there is ONE jitted program, ``serving::unified_step``, and every
+in-flight request advances through it each step as a ragged row
+carrying (query_len, context_len).  A prompt is split into
+``chunk_len``-token chunks that run as ordinary rows next to decode
+rows, writing their K/V into the paged pool incrementally, so a long
+prompt can never stall the decoding batch (head-of-line blocking) —
+the worst decode stall is one chunk step.  ``chunk_len`` is the knob:
+larger chunks finish a given prompt's prefill in fewer steps, smaller
+chunks bound the per-step latency everyone else pays.  The first token
+is sampled by the step in which the LAST chunk completes — that is the
+TTFT event (``serving_ttft_seconds``), and each chunk increments
+``serving_prefill_chunks_total``.
+
+Admission semantics: any prompt with prompt + max_new_tokens ≤
+cfg.max_seq_len (and a page count the pool could ever hold) is
+admissible — there is no prompt-length ceiling below that; the old
+``prefill_len`` gate is gone (the name survives as a legacy alias for
+``chunk_len``).  Pages are allocated chunk-by-chunk: admission reserves
+only the first chunk, later chunks extend the page table step by step,
+and memory pressure preempts the youngest row — mid-prefill rows
+included, whose already-written chunk pages are freed (likewise on
+deadline eviction).
 
 Overload behavior is part of the contract (README "Resilience"):
 infeasible requests are REJECTED hard at submit; with watermarks
@@ -25,8 +51,9 @@ still owed) divided by the engine's EWMA decode rate
 ``serving_estimated_drain_seconds`` gauge and on the telemetry server's
 ``/healthz`` (README "Flight recorder"), so front-ends and fleet
 schedulers back off by measured drain time, not a guessed constant.
-Every request is additionally traced queued→prefill→decode[i]→terminal
-through ``Engine.tracer`` (chrome-trace / JSON exportable).
+Every request is additionally traced
+queued→chunk[i]→decode[i]→terminal through ``Engine.tracer``
+(chrome-trace / JSON exportable).
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
 from .kv_cache import PagedKVCache  # noqa: F401

@@ -1,27 +1,38 @@
-"""Continuous-batching generation engine.
+"""Continuous-batching generation engine — unified-step scheduler.
 
-The serving-side counterpart of the training HybridEngine: requests enter
-a FIFO admission queue, prefill and decode run as two statically-shaped
-jitted programs (each compiles exactly once), and the in-flight decode
-batch admits new requests the moment slots and KV pages free up — no
-generation-long batch barrier (Orca-style continuous batching, the
-scheduling model vLLM/TPU serving stacks converged on).
+The serving-side counterpart of the training HybridEngine: requests
+enter a FIFO admission queue and ONE statically-shaped jitted program
+(``serving::unified_step``, compiles exactly once) advances every
+in-flight request each step — whether the request is mid-prefill or
+decoding.  There is no prefill phase: a prompt is split into
+bounded-size *chunks* (``chunk_len`` tokens) that run as ordinary rows
+of the ragged batch next to decode rows, so one long prompt can never
+stall the decoding requests sharing the batch (the head-of-line
+blocking the old prefill/decode phase split suffered from — "Ragged
+Paged Attention", arXiv:2604.15464).
 
-Phases per ``step()``:
-  1. admit — pop the queue head while a batch slot AND enough KV pages
-     for its prompt exist; run prefill (writes the prompt's K/V into
-     pages, samples the first token — TTFT).
-  2. decode — one token for every running sequence via the paged-
-     attention kernel; sample; retire finished sequences and free their
-     pages.
-  3. gauges — page-pool occupancy into the metrics registry.
+Per ``step()``:
+  1. evict — drop every request (running or queued) past its deadline.
+  2. admit — pop the queue head while a batch slot AND pages for its
+     *first chunk* exist (pages are allocated chunk-by-chunk, not for
+     the whole prompt upfront).
+  3. unified step — plan the ragged batch under ``token_budget`` packed
+     query tokens: every decode row gets its one token, then
+     mid-prefill rows split the remaining budget fairly (a newly
+     admitted short prompt is not starved behind a long one).  Chunk
+     K/V is written into the paged pool incrementally; the row whose
+     chunk completes its prompt samples the first token (TTFT), decode
+     rows sample their next token.
+  4. gauges — page-pool occupancy into the metrics registry.
 
 Admission control: requests that can NEVER fit (prompt + max_new_tokens
 over the model's max_seq_len, or more pages than the whole pool) are
 rejected at submit with Request.state == REJECTED — the engine's
-graceful-overload contract.  Requests that merely can't fit *now* stay
-queued.  If decode outgrows the pool mid-flight (admission is
-optimistic), the youngest running sequence is preempted back to the
+graceful-overload contract.  Any prompt up to that bound is admissible;
+chunking removed the old ``prefill_len`` prompt-length ceiling.
+Requests that merely can't fit *now* stay queued.  If a sequence
+outgrows the pool mid-flight (admission is optimistic), the youngest
+running sequence — mid-prefill or decoding — is preempted back to the
 queue head and recomputed later — memory pressure degrades throughput,
 never correctness.
 
@@ -47,7 +58,7 @@ Overload robustness (the production-traffic contract):
   ``serving_estimated_drain_seconds`` gauge.
 
 Flight recorder: every request is traced — a root span per request
-(one chrome-trace track), with ``queued`` / ``prefill`` /
+(one chrome-trace track), with ``queued`` / ``chunk[i]`` /
 ``decode[i]`` child spans carrying batch-slot and page-pool-occupancy
 attributes, through terminal states finished / evicted / shed.  The
 engine shares the process-wide tracer by default; with an injected
@@ -69,7 +80,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..models.gpt import GPTConfig, gpt_decode_step, gpt_init, gpt_prefill
+from ..models.gpt import GPTConfig, gpt_init, gpt_ragged_step
 from ..observability.compile_watchdog import watch
 from ..observability.tracing import Tracer, default_tracer
 from ..profiler.profiler import RecordEvent
@@ -118,6 +129,8 @@ class Request:
     t_finished: float = None
     deadline: float = None     # absolute engine-clock time, None = no TTL
     retry_after_s: float = None  # drain-estimate hint on RETRY_AFTER
+    prompt_pos: int = 0        # prompt tokens already written to pages
+    _chunks_done: int = 0      # prefill chunks completed (span index)
     _rng: object = None
     _span: object = None       # root trace span (one per request)
     _phase: object = None      # current lifecycle child span
@@ -127,21 +140,31 @@ class Request:
         return self.tokens[len(self.prompt):]
 
     def _reset_for_recompute(self):
-        """Preemption rewinds to the prompt; the reseeded rng replays the
-        exact same draws, so a preempted request's final output is
-        identical to its uninterrupted one."""
+        """Preemption rewinds to the prompt — including mid-prefill
+        chunk progress; the reseeded rng replays the exact same draws,
+        so a preempted request's final output is identical to its
+        uninterrupted one."""
         self.tokens = list(self.prompt)
+        self.prompt_pos = 0
+        self._chunks_done = 0
         self.state = RequestState.QUEUED
         self._rng = np.random.default_rng(self.sampling.seed)
 
 
 class Engine:
-    """Continuous-batching generation over a paged KV cache.
+    """Continuous-batching generation over a paged KV cache with a
+    unified (chunked-prefill) step scheduler.
 
     cfg/params: the GPT model (params default to gpt_init — useful for
     benches and tests).  page_size/num_pages size the KV pool;
-    max_batch_size fixes the decode batch (static shape); prefill_len
-    fixes the prompt pad length (static shape, default cfg.max_seq_len).
+    max_batch_size fixes the in-flight row count (static shape).
+    ``chunk_len`` bounds the prompt tokens any single row contributes
+    per step — the knob that trades TTFT of the chunked prompt against
+    the stall it imposes on everyone else (``prefill_len`` is accepted
+    as a legacy alias; it no longer caps admissible prompt length).
+    ``token_budget`` is the packed query-token width of the one
+    compiled step (default chunk_len + max_batch_size - 1: one full
+    chunk plus a decode token for every other row).
 
     Robustness knobs: ``default_ttl_s`` is the per-request deadline when
     SamplingParams doesn't set one.  ``shed_occupancy_high/low`` (pool
@@ -161,7 +184,8 @@ class Engine:
     ASSUMED_DECODE_RATE = 100.0
 
     def __init__(self, cfg: GPTConfig, params=None, *, page_size=16,
-                 num_pages=256, max_batch_size=4, prefill_len=None,
+                 num_pages=256, max_batch_size=4, chunk_len=None,
+                 token_budget=None, prefill_len=None,
                  default_ttl_s=None, shed_occupancy_high=None,
                  shed_occupancy_low=None, shed_queue_high=None,
                  shed_queue_low=None, clock=None, tracer=None):
@@ -188,8 +212,13 @@ class Engine:
         self.params = params if params is not None else gpt_init(cfg)
         self.page_size = page_size
         self.max_batch_size = max_batch_size
-        self.prefill_len = min(prefill_len or cfg.max_seq_len,
-                               cfg.max_seq_len)
+        # prefill_len kept as a legacy alias for the chunk size; prompts
+        # of ANY admissible length are chunked through it
+        self.chunk_len = max(1, min(chunk_len or prefill_len or 64,
+                                    cfg.max_seq_len))
+        self.token_budget = max(
+            token_budget or (self.chunk_len + max_batch_size - 1),
+            max_batch_size)
         self.cache = PagedKVCache(
             num_layers=cfg.num_layers, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim, num_pages=num_pages, page_size=page_size,
@@ -203,24 +232,20 @@ class Engine:
         # donation chains the page buffers through steps; XLA:CPU can't
         # donate and warns, so only donate on accelerators
         donate = (1, 2) if jax.default_backend() != "cpu" else ()
-        cfg_ = cfg
+        cfg_, max_q = cfg, self.chunk_len
 
-        def _prefill(params, k_pages, v_pages, tokens, seq_lens, tables):
-            return gpt_prefill(cfg_, params, tokens, seq_lens, k_pages,
-                               v_pages, tables)
+        def _step(params, k_pages, v_pages, tokens, rows, slots, qlens,
+                  ctxs, tables):
+            return gpt_ragged_step(cfg_, params, tokens, rows, slots,
+                                   qlens, ctxs, k_pages, v_pages, tables,
+                                   max_q=max_q)
 
-        def _decode(params, k_pages, v_pages, tokens, positions, seq_lens,
-                    tables):
-            return gpt_decode_step(cfg_, params, tokens, positions,
-                                   seq_lens, k_pages, v_pages, tables)
-
-        # watchdog-wrapped: both programs are statically shaped and must
-        # compile exactly once — any recompile here is a serving bug the
-        # watchdog flags with the offending shape diff
-        self._prefill_fn = watch(jax.jit(_prefill, donate_argnums=donate),
-                                 name="serving::prefill")
-        self._decode_fn = watch(jax.jit(_decode, donate_argnums=donate),
-                                name="serving::decode")
+        # watchdog-wrapped: the ONE statically-shaped program — prompt
+        # chunks and decode rows share it — must compile exactly once;
+        # any recompile here is a serving bug the watchdog flags with
+        # the offending shape diff
+        self._step_fn = watch(jax.jit(_step, donate_argnums=donate),
+                              name="serving::unified_step")
 
     # ------------------------------------------------------------- submit
     def add_request(self, prompt, sampling: SamplingParams = None):
@@ -245,13 +270,12 @@ class Engine:
                         "prompt_len": len(req.prompt),
                         "max_new_tokens": sampling.max_new_tokens})
 
+        # chunked prefill admits any prompt the model itself can hold —
+        # there is deliberately NO prompt-length gate below max_seq_len
         total = len(req.prompt) + sampling.max_new_tokens
         reason = None
         if not req.prompt:
             reason = "empty prompt"
-        elif len(req.prompt) > self.prefill_len:
-            reason = (f"prompt length {len(req.prompt)} exceeds "
-                      f"prefill_len {self.prefill_len}")
         elif total > self.cfg.max_seq_len:
             reason = (f"prompt + max_new_tokens = {total} exceeds "
                       f"max_seq_len {self.cfg.max_seq_len}")
@@ -401,8 +425,10 @@ class Engine:
             if slot is None:
                 return
             req = self._queue[0]
-            # optimistic admission: pages for the prompt + first new token
-            if not self.cache.allocate(req.id, len(req.prompt) + 1):
+            # chunk-granularity admission: pages for the FIRST chunk
+            # only — later chunks extend the table step by step
+            first = min(self.chunk_len, len(req.prompt))
+            if not self.cache.allocate(req.id, first):
                 return                       # FIFO: no queue-jumping
             self._queue.popleft()
             now = self._clock()
@@ -419,33 +445,8 @@ class Engine:
                     "batch_slot": slot,
                     "occupancy_at_admit":
                         round(self.cache.occupancy(), 4)})
-            self._prefill(req)
 
-    def _prefill(self, req):
-        n = len(req.prompt)
-        req._phase = self.tracer.start_span(
-            "prefill", req._span, attributes={"prompt_len": n}) \
-            if req._span is not None else None
-        toks = np.zeros((1, self.prefill_len), np.int32)
-        toks[0, :n] = req.prompt
-        tables = np.asarray([self.cache.page_table(req.id)], np.int32)
-        with RecordEvent("serving::prefill"):
-            logits, k, v = self._prefill_fn(
-                self.params, self.cache.k_pages, self.cache.v_pages,
-                jnp.asarray(toks), jnp.asarray([n], jnp.int32),
-                jnp.asarray(tables))
-            logits = np.asarray(logits)
-        self.cache.k_pages, self.cache.v_pages = k, v
-        self.metrics.prefill_tokens.inc(n)
-        tok = self._sample_token(logits[0], req)
-        req.tokens.append(tok)
-        req.t_first_token = self._clock()
-        self.metrics.ttft.observe(req.t_first_token - req.t_submit)
-        self.metrics.tokens_generated.inc()
-        self._end_phase(req, end_s=req.t_first_token)  # prefill done
-        self._maybe_finish(req)
-
-    # -------------------------------------------------------------- decode
+    # -------------------------------------------------------- unified step
     def _running(self):
         return [r for r in self._slots if r is not None]
 
@@ -465,73 +466,157 @@ class Engine:
                 req._span.attributes.get("preemptions", 0) + 1
             req._phase = self.tracer.start_span("queued", req._span)
 
-    def _ensure_capacity(self):
-        """Every running sequence needs a page slot for the token decode
-        is about to write; preempt youngest-first when the pool runs dry."""
-        for req in sorted(self._running(), key=lambda r: r._admit_seq):
-            if req not in self._slots:
-                continue                     # already preempted this pass
-            while not self.cache.extend(req.id, len(req.tokens)):
-                victim = max(self._running(), key=lambda r: r._admit_seq)
-                self._preempt(victim)
-                if victim is req:
-                    break
-
-    def _decode_once(self):
-        self._ensure_capacity()
-        running = self._running()
-        if not running:
-            return
-        B = self.max_batch_size
-        tokens = np.zeros((B,), np.int32)
-        positions = np.zeros((B,), np.int32)
-        seq_lens = np.zeros((B,), np.int32)
-        tables = np.zeros((B, self.cache.max_pages_per_seq), np.int32)
+    def _plan_rows(self):
+        """{batch slot: query tokens this step} under token_budget.
+        Decode rows always get their one token; mid-prefill rows then
+        split the remaining budget fairly (ceil-share, admission order)
+        so a short prompt admitted behind a long one still makes
+        progress toward its TTFT instead of starving."""
+        plan = {}
+        budget = self.token_budget
+        chunkers = []
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
-            tokens[i] = req.tokens[-1]
-            positions[i] = len(req.tokens) - 1
-            seq_lens[i] = len(req.tokens)
+            if req.prompt_pos >= len(req.prompt):
+                plan[i] = 1
+                budget -= 1
+            else:
+                chunkers.append(i)
+        chunkers.sort(key=lambda i: self._slots[i]._admit_seq)
+        for n, i in enumerate(chunkers):
+            if budget <= 0:
+                break
+            req = self._slots[i]
+            fair = -(-budget // (len(chunkers) - n))          # ceil share
+            q = min(self.chunk_len, len(req.prompt) - req.prompt_pos,
+                    fair)
+            if q > 0:
+                plan[i] = q
+                budget -= q
+        return plan
+
+    def _ensure_capacity(self):
+        """Pages for every planned row's post-step context — the chunk a
+        mid-prefill row is about to write, or the token decode is about
+        to append; preempt youngest-first (mid-prefill rows included)
+        when the pool runs dry.  Returns the final, feasible plan."""
+        while True:
+            plan = self._plan_rows()
+            stable = True
+            for i in sorted(plan, key=lambda i: self._slots[i]._admit_seq
+                            if self._slots[i] is not None else 0):
+                req = self._slots[i]
+                if req is None:
+                    continue                 # preempted earlier this pass
+                if req.prompt_pos < len(req.prompt):
+                    target = req.prompt_pos + plan[i]
+                else:
+                    target = len(req.tokens)
+                while req in self._slots and \
+                        not self.cache.extend(req.id, target):
+                    victim = max(self._running(),
+                                 key=lambda r: r._admit_seq)
+                    self._preempt(victim)
+                    stable = False
+                    if victim is req:
+                        break
+            if stable:
+                return plan
+
+    def _unified_step_once(self, plan):
+        """Run the one jitted program over the planned ragged batch and
+        fold the results back into each request's lifecycle."""
+        if not plan:
+            return
+        B, T = self.max_batch_size, self.token_budget
+        tokens = np.zeros((T,), np.int32)
+        rows = np.full((T,), B, np.int32)        # B marks padding slots
+        slots = np.zeros((T,), np.int32)
+        qlens = np.zeros((B,), np.int32)
+        ctxs = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.cache.max_pages_per_seq), np.int32)
+        sched = []                               # (slot, req, q, new ctx)
+        off = 0
+        for i in range(B):                       # packing is row-major
+            req = self._slots[i]
+            q = plan.get(i, 0)
+            if req is None or q <= 0:
+                continue
+            if req.prompt_pos < len(req.prompt):
+                chunk = req.prompt[req.prompt_pos:req.prompt_pos + q]
+                ctx = req.prompt_pos + q
+            else:
+                chunk = req.tokens[-1:]
+                ctx = len(req.tokens)
+            tokens[off:off + q] = chunk
+            rows[off:off + q] = i
+            slots[off:off + q] = np.arange(q)
+            qlens[i], ctxs[i] = q, ctx
             tables[i] = self.cache.page_table(req.id)
+            sched.append((i, req, q, ctx))
+            off += q
         t0 = self._clock()
-        with RecordEvent("serving::decode"):
-            logits, k, v = self._decode_fn(
+        with RecordEvent("serving::unified_step"):
+            logits, k, v = self._step_fn(
                 self.params, self.cache.k_pages, self.cache.v_pages,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(seq_lens), jnp.asarray(tables))
+                jnp.asarray(tokens), jnp.asarray(rows),
+                jnp.asarray(slots), jnp.asarray(qlens),
+                jnp.asarray(ctxs), jnp.asarray(tables))
             logits = np.asarray(logits)
         self.cache.k_pages, self.cache.v_pages = k, v
         t1 = self._clock()
         dt = t1 - t0
-        n_active = len(running)
-        if dt > 0:
+        occ = round(self.cache.occupancy(), 4)
+        n_rows = len(sched)
+        sampled = 0
+        for i, req, q, ctx in sched:
+            mid_prefill = req.prompt_pos < len(req.prompt)
+            if mid_prefill:
+                req.prompt_pos = ctx
+                self.metrics.prefill_tokens.inc(q)
+                self.metrics.prefill_chunks.inc()
+                if req._span is not None:
+                    self.tracer.start_span(
+                        f"chunk[{req._chunks_done}]", req._span,
+                        start_s=t0,
+                        attributes={"tokens": q, "prefilled": ctx,
+                                    "batch_slot": i,
+                                    "batch_size": n_rows,
+                                    "page_occupancy": occ}).end(t1)
+                req._chunks_done += 1
+                if ctx < len(req.prompt):
+                    continue                 # more chunks to go
+                # the chunk that completed the prompt falls through and
+                # samples the request's first token — TTFT
+            tok = self._sample_token(logits[i], req)
+            req.tokens.append(tok)
+            sampled += 1
+            self.metrics.tokens_generated.inc()
+            if req.t_first_token is None:
+                # time-to-first-SAMPLED-token: stamped when the last
+                # prompt chunk completes, not when prefill starts
+                req.t_first_token = t1
+                self.metrics.ttft.observe(t1 - req.t_submit)
+            if not mid_prefill:
+                self.metrics.decode_token.observe(dt / n_rows)
+                if req._span is not None:
+                    # retroactive span over the batched step this
+                    # request rode in — one decode[i] per token
+                    self.tracer.start_span(
+                        f"decode[{len(req.output) - 1}]", req._span,
+                        start_s=t0,
+                        attributes={"batch_slot": i,
+                                    "batch_size": n_rows,
+                                    "page_occupancy": occ}).end(t1)
+            self._maybe_finish(req)
+        if dt > 0 and sampled:
             # EWMA decode throughput feeds the drain/retry-after hint
-            inst = n_active / dt
+            inst = sampled / dt
             a = self._ewma_alpha
             self._decode_rate_ewma = (
                 inst if self._decode_rate_ewma is None
                 else a * inst + (1 - a) * self._decode_rate_ewma)
-        occ = round(self.cache.occupancy(), 4)
-        for i, req in enumerate(self._slots):
-            if req is None:
-                continue
-            tok = self._sample_token(logits[i], req)
-            req.tokens.append(tok)
-            if req.t_first_token is None:
-                req.t_first_token = self._clock()
-            self.metrics.tokens_generated.inc()
-            self.metrics.decode_token.observe(dt / n_active)
-            if req._span is not None:
-                # retroactive span over the batched step this request
-                # rode in — one decode[i] per generated token
-                self.tracer.start_span(
-                    f"decode[{len(req.output) - 1}]", req._span,
-                    start_s=t0, attributes={"batch_slot": i,
-                                            "batch_size": n_active,
-                                            "page_occupancy": occ},
-                ).end(t1)
-            self._maybe_finish(req)
 
     # ------------------------------------------------------------ sampling
     def _sample_token(self, logits_row, req):
@@ -584,11 +669,12 @@ class Engine:
 
     def step(self):
         """One scheduler iteration: evict past-deadline requests, admit,
-        decode one token for the batch, update gauges.  Returns requests
-        that finished (or were evicted) this step."""
+        run the unified ragged step (prompt chunks + decode rows in one
+        batch), update gauges.  Returns requests that finished (or were
+        evicted) this step."""
         self._evict_expired()
         self._try_admit()
-        self._decode_once()
+        self._unified_step_once(self._ensure_capacity())
         self._update_shedding()
         self.metrics.page_occupancy.set(self.cache.occupancy())
         self.metrics.queue_depth.set(len(self._queue))

@@ -9,6 +9,12 @@ each.  The pool is a single stacked array [L, P, page_size, H, hd]
 (layer-major so the model's lax.scan over layers consumes it as per-layer
 xs/ys), bf16 by default.
 
+Allocation is chunk-granular: the engine's chunked-prefill scheduler
+``allocate``s only a prompt's first chunk at admission and ``extend``s
+the table as later chunks (and decode tokens) land, so a long prompt
+holds exactly the pages its written tokens need — never a whole-prompt
+reservation sitting idle while other requests starve.
+
 Host-side bookkeeping (free list, page tables) is plain Python — it sits
 on the scheduler path, not the device path; the device only ever sees the
 dense page arrays plus int32 tables the engine builds per step.

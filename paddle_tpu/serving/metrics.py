@@ -5,9 +5,12 @@ The Counter/Gauge/Histogram primitives were promoted to
 process-wide registry); this module keeps the serving-shaped facade:
 
   queue_wait   — submit -> admission (scheduler pressure)
-  ttft         — submit -> first token (prefill + queueing, the user-felt
-                 latency of a streaming response's first byte)
+  ttft         — submit -> first SAMPLED token, i.e. the step in which
+                 the prompt's last chunk completed (chunked prefill:
+                 queueing + every chunk step — the user-felt latency of
+                 a streaming response's first byte)
   decode_token — per-token decode step time (steady-state speed)
+  prefill_chunks — prompt chunks run through the unified step
   page_occupancy — page-pool utilisation gauge, 0..1
 
 Every metric is registered (serving_-prefixed) into the default
@@ -69,6 +72,10 @@ class ServingMetrics:
             help="1 = healthy (admitting), 0 = degraded (shedding)"))
         self.engine_healthy.set(1)
         self.prefill_tokens = add(Counter("serving_prefill_tokens_total"))
+        self.prefill_chunks = add(Counter(
+            "serving_prefill_chunks_total",
+            help="prompt chunks run through the unified step (chunked "
+                 "prefill: a prompt is ceil(len/chunk_len) of these)"))
         self.tokens_generated = add(Counter(
             "serving_tokens_generated_total"))
         # unit suffixes are canonical (_seconds, not _s) —
@@ -99,6 +106,7 @@ class ServingMetrics:
             "engine_healthy": self.engine_healthy.value,
             "tokens": {
                 "prefill": self.prefill_tokens.value,
+                "prefill_chunks": self.prefill_chunks.value,
                 "generated": self.tokens_generated.value,
             },
             "queue_wait_s": self.queue_wait.summary(),

@@ -114,9 +114,9 @@ class TestTracer:
 
 
 class TestEngineRequestTracing:
-    def test_request_span_tree_nests_prefill_and_decode(self):
+    def test_request_span_tree_nests_chunk_and_decode(self):
         """Acceptance: a request traced through generate() yields a
-        chrome-exportable span tree whose prefill/decode spans nest
+        chrome-exportable span tree whose chunk/decode spans nest
         under the request root — injectable clock, no sleeps."""
         eng = _tiny_engine(clock=ManualClock(auto=0.001))
         eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
@@ -126,16 +126,16 @@ class TestEngineRequestTracing:
         assert root["parent_id"] is None
         assert root["attributes"]["state"] == "finished"
         assert root["attributes"]["batch_slot"] == 0
-        assert {"queued", "prefill", "decode[1]", "decode[2]"} <= set(spans)
+        assert {"queued", "chunk[0]", "decode[1]", "decode[2]"} <= set(spans)
         for name, s in spans.items():
             if name == "request#0":
                 continue
             assert s["parent_id"] == root["span_id"]
             assert root["start_s"] <= s["start_s"]
             assert s["end_s"] <= root["end_s"]
-        # lifecycle order: queued → prefill → decode[i]
-        assert spans["queued"]["end_s"] <= spans["prefill"]["start_s"]
-        assert spans["prefill"]["end_s"] <= spans["decode[1]"]["start_s"]
+        # lifecycle order: queued → chunk[i] → decode[i]
+        assert spans["queued"]["end_s"] <= spans["chunk[0]"]["start_s"]
+        assert spans["chunk[0]"]["end_s"] <= spans["decode[1]"]["start_s"]
         # occupancy rides on the decode spans
         assert spans["decode[1]"]["attributes"]["page_occupancy"] > 0
 
@@ -176,7 +176,7 @@ class TestEngineRequestTracing:
         for name, e in req0.items():
             assert e["ts"] >= root["ts"]
             assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-6
-        assert "prefill" in req0 and "queued" in req0
+        assert "chunk[0]" in req0 and "queued" in req0
         # evicted and shed requests still produce tracks
         assert any(e["name"] == "request#1" for e in by_track["request#1"])
         assert any(e["name"] == "request#2" for e in by_track["request#2"])
@@ -376,7 +376,7 @@ class TestTelemetryServerE2E:
             for t in traces:
                 names = [s["name"] for s in t["spans"]]
                 assert names[0].startswith("request#")
-                assert "prefill" in names
+                assert "chunk[0]" in names
 
             code, _, body = _get(srv.url + "/traces?limit=1")
             assert len(json.loads(body)["traces"]) == 1

@@ -236,9 +236,10 @@ class TestCompileWatchdog:
         assert callable(f.__wrapped__)
 
     def test_serving_engine_compiles_each_program_once(self, obs_caplog):
-        """The engine's 'two statically-shaped programs, each compiles
-        exactly once' contract, watched live across ragged prompts and
-        mid-flight admission."""
+        """The engine's 'ONE statically-shaped program compiles exactly
+        once' contract — prompt chunks and decode rows share the unified
+        step — watched live across ragged prompts, a prompt long enough
+        to span several chunks, and mid-flight admission."""
         from paddle_tpu.models.gpt import GPT_CONFIGS
         from paddle_tpu.serving import Engine, SamplingParams
 
@@ -246,12 +247,12 @@ class TestCompileWatchdog:
                              logger="paddle_tpu.observability"), \
                 watchdog_enabled() as wd:
             eng = Engine(GPT_CONFIGS["tiny"], page_size=4, num_pages=64,
-                         max_batch_size=2, prefill_len=16)
-            eng.generate([[1, 2, 3], [4, 5], [6, 7, 8, 9]],
+                         max_batch_size=2, chunk_len=16)
+            eng.generate([[1, 2, 3], [4, 5], list(range(40))],
                          SamplingParams(max_new_tokens=3))
             rep = wd.report()
-        assert rep["serving::prefill"]["compiles"] == 1
-        assert rep["serving::decode"]["compiles"] == 1
+        assert rep["serving::unified_step"]["compiles"] == 1
+        assert rep["serving::unified_step"]["calls"] > 1
         assert not [r for r in obs_caplog.records
                     if r.levelno >= logging.WARNING]
 

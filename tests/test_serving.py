@@ -12,6 +12,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.kernels.paged_attention import (_paged_attention_kernel,
                                                 _paged_attention_ref,
+                                                _ragged_attention_kernel,
+                                                _ragged_attention_ref,
                                                 paged_attention,
                                                 paged_attention_available)
 from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_forward, gpt_init
@@ -160,6 +162,84 @@ class TestPagedAttention:
         assert out.shape == q.shape and out.dtype == q.dtype
 
 
+# ------------------------------------------ ragged (fused prefill+decode)
+
+
+class TestRaggedAttention:
+    """The unified kernel: every batch row at an arbitrary position —
+    mid-prefill chunk, decode step, or idle."""
+
+    def _case(self, qlens, ctxs, Q=6, dtype=jnp.float32):
+        B = len(qlens)
+        H, hd, P, ps, M = 2, 8, 12, 4, 6
+        ks = jax.random.split(jax.random.key(2), 3)
+        q = jax.random.normal(ks[0], (B, Q, H, hd), dtype)
+        kp = jax.random.normal(ks[1], (P, ps, H, hd), dtype)
+        vp = jax.random.normal(ks[2], (P, ps, H, hd), dtype)
+        rng = np.random.RandomState(0)
+        tables = jnp.asarray(
+            np.stack([rng.permutation(P)[:M] for _ in range(B)]), jnp.int32)
+        return (q, kp, vp, tables, jnp.asarray(qlens, jnp.int32),
+                jnp.asarray(ctxs, jnp.int32))
+
+    def test_ref_matches_dense_causal_oracle(self):
+        """Each query token must equal dense softmax attention over the
+        kv prefix ending at its own absolute position (causal within
+        the chunk, full context before it)."""
+        # context lengths straddle the page_size=4 boundary: 7, 8, 9
+        q, kp, vp, tables, qlens, ctxs = self._case([5, 1, 3, 0],
+                                                    [7, 8, 9, 0])
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        out = _ragged_attention_ref(q, kp, vp, tables, qlens, ctxs, scale)
+        for b in range(q.shape[0]):
+            ql, cl = int(qlens[b]), int(ctxs[b])
+            k = jnp.concatenate([kp[p] for p in np.asarray(tables[b])], 0)
+            v = jnp.concatenate([vp[p] for p in np.asarray(tables[b])], 0)
+            for t in range(q.shape[1]):
+                if t >= ql:
+                    np.testing.assert_array_equal(np.asarray(out[b, t]),
+                                                  0.0)
+                    continue
+                n = cl - ql + t + 1          # causal horizon of token t
+                s = jnp.einsum("hd,thd->ht", q[b, t], k[:n]) * scale
+                ref = jnp.einsum("ht,thd->hd", jax.nn.softmax(s, -1), v[:n])
+                np.testing.assert_allclose(np.asarray(out[b, t]),
+                                           np.asarray(ref),
+                                           rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.skipif(not paged_attention_available(),
+                        reason="pallas unavailable")
+    def test_kernel_matches_ref_mixed_rows(self):
+        """Interpret-mode kernel == ref for a batch mixing a mid-prefill
+        chunk, a prompt-completing chunk, a decode row, and an idle row,
+        with context lengths straddling page boundaries."""
+        for qlens, ctxs in ([(5, 1, 3, 0), (14, 6, 3, 0)],
+                            [(6, 6, 1, 1), (7, 8, 9, 24)],
+                            [(1, 1, 1, 1), (4, 5, 16, 17)]):
+            q, kp, vp, tables, ql, cl = self._case(list(qlens), list(ctxs))
+            scale = 1.0 / np.sqrt(q.shape[-1])
+            ref = _ragged_attention_ref(q, kp, vp, tables, ql, cl, scale)
+            ker = _ragged_attention_kernel(q, kp, vp, tables, ql, cl,
+                                           scale, interpret=True)
+            np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.skipif(not paged_attention_available(),
+                        reason="pallas unavailable")
+    def test_decode_entry_is_qlen1_degenerate_row(self):
+        """The legacy decode entry must equal a Q=1 ragged call."""
+        q, kp, vp, tables, _, _ = self._case([1, 1, 1], [9, 4, 0], Q=1)
+        lens = jnp.asarray([9, 4, 0], jnp.int32)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        dec = _paged_attention_kernel(q[:, 0], kp, vp, tables, lens, scale,
+                                      interpret=True)
+        rag = _ragged_attention_kernel(q, kp, vp, tables,
+                                       (lens > 0).astype(jnp.int32), lens,
+                                       scale, interpret=True)[:, 0]
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(rag),
+                                   rtol=1e-6, atol=1e-6)
+
+
 # ------------------------------------------------- continuous batching
 
 
@@ -281,6 +361,217 @@ class TestEngine:
         snap = pred.metrics()
         assert snap["requests"]["finished"] == 1
         assert snap["ttft_s"]["count"] == 1
+
+
+# ------------------------------------------------------- chunked prefill
+
+
+class TestChunkedPrefill:
+    """The unified-step scheduler: prompts become N bounded chunks
+    interleaved with decode rows instead of one batch-stalling pass."""
+
+    def test_long_prompt_chunked_greedy_parity(self, tiny_model):
+        """Prompts straddling page boundaries, chunked 4 tokens at a
+        time (page_size 8 — chunks cross pages mid-way), stay
+        token-identical to the full-recompute oracle."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(11)
+        prompts = [list(rng.randint(0, cfg.vocab_size, n))
+                   for n in (15, 16, 17, 3)]
+        refs = [naive_generate(cfg, params, p, 6) for p in prompts]
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=4)
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=6))
+        assert outs == refs
+        m = eng.metrics.snapshot()
+        assert m["tokens"]["prefill"] == sum(len(p) for p in prompts)
+        # at least ceil(len / chunk_len) chunk rows per prompt (fair
+        # sharing between concurrent prefills can split finer)
+        assert m["tokens"]["prefill_chunks"] >= sum(
+            -(-len(p) // 4) for p in prompts)
+        assert eng.cache.num_free_pages == eng.cache.num_pages
+
+    def test_prompt_longer_than_chunk_admitted(self, tiny_model):
+        """The old prefill_len prompt-length rejection is gone: any
+        prompt that fits max_seq_len is admitted and chunked."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(13)
+        prompt = list(rng.randint(0, cfg.vocab_size, 100))
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=16)
+        req = eng.add_request(prompt, SamplingParams(max_new_tokens=4))
+        assert req.state == RequestState.QUEUED      # not rejected
+        while eng.has_work():
+            eng.step()
+        assert req.state == RequestState.FINISHED
+        assert req.output == naive_generate(cfg, params, prompt, 4)
+        # infeasible-by-model-size is still rejected hard
+        too_long = list(rng.randint(0, cfg.vocab_size, cfg.max_seq_len))
+        rej = eng.add_request(too_long, SamplingParams(max_new_tokens=4))
+        assert rej.state == RequestState.REJECTED
+
+    def test_chunk_rows_interleave_with_decode_rows(self, tiny_model):
+        """A long prompt arriving mid-decode prefills chunk-by-chunk in
+        the same steps that keep decoding the running requests — and
+        nobody's output diverges from its solo run."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(17)
+        early = [list(rng.randint(0, cfg.vocab_size, n)) for n in (5, 7)]
+        long_p = list(rng.randint(0, cfg.vocab_size, 24))
+        sp = SamplingParams(max_new_tokens=10)
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=4, chunk_len=4)
+        reqs = [eng.add_request(p, sp) for p in early]
+        for _ in range(3):
+            eng.step()
+        assert all(len(r.output) >= 1 for r in reqs)
+        before = [len(r.output) for r in reqs]
+        late = eng.add_request(long_p, sp)
+        eng.step()                            # late's first chunk runs...
+        assert 0 < late.prompt_pos < len(long_p)
+        after = [len(r.output) for r in reqs
+                 if r.state == RequestState.RUNNING]
+        # ...and every still-running early request still got its decode
+        # token in that same step (no prefill stall)
+        assert all(a > b for a, b in zip(after, before[:len(after)]))
+        while eng.has_work():
+            eng.step()
+        assert late.output == naive_generate(cfg, params, long_p, 10)
+        for r, p in zip(reqs, early):
+            assert r.output == naive_generate(cfg, params, p, 10)
+
+    def test_ttft_is_first_sampled_token(self, tiny_model):
+        """serving_ttft_seconds must cover queueing + every chunk step:
+        the first token exists only once the LAST chunk completed."""
+        cfg, params = tiny_model
+
+        class Clock:
+            def __init__(self):
+                self.t = 0.0
+
+            def __call__(self):
+                self.t += 1.0
+                return self.t
+
+        clk = Clock()
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=1, chunk_len=4, clock=clk)
+        prompt = list(range(12))              # 3 chunks
+        req = eng.add_request(prompt, SamplingParams(max_new_tokens=2))
+        eng.step()
+        assert req.prompt_pos == 4 and req.t_first_token is None
+        assert eng.metrics.ttft.summary()["count"] == 0
+        eng.step()
+        assert req.prompt_pos == 8 and req.t_first_token is None
+        eng.step()                            # completing chunk samples
+        assert req.prompt_pos == 12
+        assert req.t_first_token is not None
+        assert len(req.output) == 1
+        assert eng.metrics.ttft.summary()["count"] == 1
+        assert eng.metrics.prefill_chunks.value == 3
+        # tracer shows the chunked lifecycle, not a monolithic prefill
+        while eng.has_work():
+            eng.step()
+        (tr,) = [t for t in eng.tracer.traces()
+                 if t["name"] == f"request#{req.id}"]
+        names = [s["name"] for s in tr["spans"]]
+        assert {"chunk[0]", "chunk[1]", "chunk[2]", "decode[1]"} <= \
+            set(names)
+        assert "prefill" not in names
+
+    def test_mid_prefill_deadline_eviction_frees_chunk_pages(self,
+                                                             tiny_model):
+        """Regression (this PR): a request evicted mid-prefill must
+        return its already-written chunk pages to the pool."""
+        cfg, params = tiny_model
+
+        class ManualClock:
+            def __init__(self):
+                self.t = 0.0
+
+            def advance(self, dt):
+                self.t += dt
+
+            def __call__(self):
+                return self.t
+
+        clk = ManualClock()
+        eng = Engine(cfg, params, page_size=4, num_pages=32,
+                     max_batch_size=2, chunk_len=4, clock=clk)
+        req = eng.add_request(list(range(14)), SamplingParams(
+            max_new_tokens=4, ttl_s=5.0))
+        clk.advance(1.0)
+        eng.step()                            # first chunk written
+        assert req.state == RequestState.RUNNING
+        assert 0 < req.prompt_pos < len(req.prompt)
+        assert eng.cache.num_used_pages > 0
+        clk.advance(10.0)                     # deadline passes mid-prefill
+        done = eng.step()
+        assert req in done
+        assert req.state == RequestState.EVICTED
+        assert req.finish_reason == "deadline"
+        assert req.output == []               # never sampled
+        assert eng.cache.num_free_pages == eng.cache.num_pages
+        assert eng.metrics.deadline_evictions.value == 1
+
+    def test_preemption_mid_prefill_is_lossless(self, tiny_model):
+        """Memory pressure that preempts a request WHILE its prompt is
+        still chunking must rewind chunk progress too: the recomputed
+        request's greedy output equals its uninterrupted solo run."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(19)
+        p_a = list(rng.randint(0, cfg.vocab_size, 8))
+        p_b = list(rng.randint(0, cfg.vocab_size, 14))
+        sp_a = SamplingParams(max_new_tokens=8)
+        sp_b = SamplingParams(max_new_tokens=2)
+        eng = Engine(cfg, params, page_size=4, num_pages=6,
+                     max_batch_size=2, chunk_len=4)   # 24-token pool
+        a = eng.add_request(p_a, sp_a)
+        b = eng.add_request(p_b, sp_b)
+        saw_mid_prefill_preemption = False
+        while eng.has_work():
+            pre = eng.metrics.requests_preempted.value
+            mid = {r.id: 0 < r.prompt_pos < len(r.prompt)
+                   for r in (a, b)}
+            eng.step()
+            if eng.metrics.requests_preempted.value > pre:
+                # a preemption fired; was the rewound request mid-prefill?
+                for r in (a, b):
+                    if (r.state == RequestState.QUEUED and mid[r.id]
+                            and r.prompt_pos == 0):
+                        saw_mid_prefill_preemption = True
+        assert eng.metrics.requests_preempted.value > 0
+        assert saw_mid_prefill_preemption
+        assert a.output == naive_generate(cfg, params, p_a, 8)
+        assert b.output == naive_generate(cfg, params, p_b, 2)
+        assert eng.cache.num_free_pages == eng.cache.num_pages
+
+    def test_fair_chunk_budget_between_concurrent_prefills(self,
+                                                           tiny_model):
+        """A short prompt admitted while a long one is mid-prefill
+        shares the chunk budget instead of starving behind it — its
+        TTFT lands before the long prompt finishes prefilling."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(23)
+        long_p = list(rng.randint(0, cfg.vocab_size, 60))
+        short_p = list(rng.randint(0, cfg.vocab_size, 6))
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=8)
+        sp = SamplingParams(max_new_tokens=4)
+        long_r = eng.add_request(long_p, sp)
+        eng.step()                            # long starts chunking
+        assert 0 < long_r.prompt_pos < len(long_p)
+        short_r = eng.add_request(short_p, sp)
+        steps_to_short_ttft = 0
+        while short_r.t_first_token is None and eng.has_work():
+            eng.step()
+            steps_to_short_ttft += 1
+        assert short_r.t_first_token is not None
+        assert long_r.prompt_pos < len(long_p)   # long still prefilling
+        while eng.has_work():
+            eng.step()
+        assert short_r.output == naive_generate(cfg, params, short_p, 4)
+        assert long_r.output == naive_generate(cfg, params, long_p, 4)
 
 
 # -------------------------------------------- robustness under overload
