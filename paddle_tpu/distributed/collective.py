@@ -11,6 +11,18 @@ the property the reference's program-rewriting passes rely on (N26).  Outside
 any mesh region (plain eager, world=1 per process) they degrade to their
 single-participant semantics so user code runs unchanged on one chip.
 There are no streams or Task handles: XLA owns async scheduling.
+
+Every public op routes through the distributed flight recorder
+(:func:`~paddle_tpu.observability.flight.record_collective` — enforced
+by ``tools/check_collective_instrumented.py``): each call gets a
+monotonic sequence number, byte/shape accounting, a ``collective::<op>``
+tracer span and the ``collective_*`` registry series.  Inside a jit
+region the record is taken at trace time (one per compile — collectives
+are ops in the graph there); eager calls record real wall time.  The
+``collective.all_reduce`` / ``collective.barrier`` fault sites make
+cross-rank hangs reproducible on CPU (``kind="stall"`` freezes a rank
+mid-collective with the record in flight — exactly what the
+:class:`~paddle_tpu.observability.flight.HangWatchdog` must localize).
 """
 from __future__ import annotations
 
@@ -18,6 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..observability.flight import record_collective
+from ..resilience.faults import fault_point
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "reduce", "broadcast", "scatter", "reduce_scatter",
@@ -138,9 +152,11 @@ def _cross_process_all_reduce(x, op=ReduceOp.SUM):
     return jnp.asarray(out.addressable_data(0))
 
 
+@record_collective("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_allreduce_{sum,max,min,prod} analog; inside shard_map → lax.psum;
     eager with multiple processes → cross-process reduce via XLA."""
+    fault_point("collective.all_reduce")
     axis = _axis(group)
     x = _unwrap(tensor)
     if axis is None:
@@ -173,6 +189,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
+@record_collective("all_gather")
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
     """c_allgather analog; inside shard_map → lax.all_gather."""
     # support both signatures: all_gather(out_list, x) and x2 = all_gather(x)
@@ -197,12 +214,14 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
     return _wrap_like(tensor_or_list, out)
 
 
+@record_collective("reduce")
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     # SPMD: every participant computes the reduction (psum), matching dst's
     # value; cheaper than masking and semantically compatible.
     return all_reduce(tensor, op=op, group=group)
 
 
+@record_collective("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """c_broadcast analog: take src's shard value on all members."""
     axis = _axis(group)
@@ -218,6 +237,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return out
 
 
+@record_collective("scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     axis = _axis(group)
     if axis is None:
@@ -236,6 +256,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return out
 
 
+@record_collective("reduce_scatter")
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """c_reducescatter analog; inside shard_map → lax.psum_scatter."""
@@ -249,6 +270,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     return _wrap_like(tensor, out)
 
 
+@record_collective("all_to_all")
 def all_to_all(in_tensor_or_list, out_tensor_list=None, group=None,
                sync_op=True, split_axis=0, concat_axis=0):
     """alltoall analog (MoE global_scatter/global_gather building block);
@@ -268,6 +290,7 @@ def all_to_all(in_tensor_or_list, out_tensor_list=None, group=None,
     return _wrap_like(in_tensor_or_list, out)
 
 
+@record_collective("ppermute")
 def ppermute(tensor, perm, group=None):
     """collective_permute — the partial_send/partial_recv analog used by the
     pipeline schedule (send_v2/recv_v2, N26)."""
@@ -279,6 +302,7 @@ def ppermute(tensor, perm, group=None):
     return _wrap_like(tensor, out)
 
 
+@record_collective("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     # point-to-point inside SPMD is a ppermute with a single pair; the caller
     # on the receiving side must issue the matching recv with the same perm.
@@ -287,13 +311,16 @@ def send(tensor, dst=0, group=None, sync_op=True):
         "or the pipeline engine's p2p helpers")
 
 
+@record_collective("recv")
 def recv(tensor, src=0, group=None, sync_op=True):
     raise NotImplementedError(
         "raw send/recv are not SPMD-expressible; use ppermute (both sides) "
         "or the pipeline engine's p2p helpers")
 
 
+@record_collective("barrier")
 def barrier(group=None):
+    fault_point("collective.barrier")
     axis = _axis(group)
     if axis is None:
         # eager: drain device queue (closest analog of a stream sync barrier)
@@ -302,6 +329,7 @@ def barrier(group=None):
     jax.lax.psum(jnp.zeros((), jnp.float32), axis)
 
 
+@record_collective("split")
 def split(x, num_or_sections, axis=0, group=None):
     """c_split analog: take this rank's slice along ``axis``."""
     ax_name = _axis(group)

@@ -315,9 +315,14 @@ class Model:
         # epoch/step — training and serving traces share one timeline
         # vocabulary (a fit step and a request decode step correlate in
         # the same chrome trace / /traces payload)
+        from ..observability.flight import default_flight_recorder
         from ..observability.tracing import default_tracer
 
         tracer = default_tracer()
+        # step-progress heartbeat for the hang watchdog: stamping the
+        # flight recorder each batch lets cross-rank heartbeats and
+        # debug bundles say WHERE in training every rank was
+        flight = default_flight_recorder()
         for epoch in range(resume_epoch, epochs):
             cblist.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -327,6 +332,7 @@ class Model:
                 if epoch == resume_epoch and step < resume_step:
                     continue           # already trained before the crash
                 cblist.on_train_batch_begin(step)
+                flight.note_step(step, epoch=epoch)
                 x, y = batch[0], batch[1]
                 with tracer.trace("hapi::step",
                                   {"epoch": epoch, "step": step}) as sp:

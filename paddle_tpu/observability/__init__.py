@@ -44,6 +44,15 @@ platform/monitor.h grown into a production observability stack):
   ages out stale ranks, and computes the straggler skew gauge
   (:class:`ClusterAggregator`); the telemetry server serves the merged
   exposition fleet-wide.
+- :mod:`.flight` — the *distributed* flight recorder: every public
+  collective op records into a bounded per-process ring
+  (:class:`FlightRecorder` — seq numbers, shapes/bytes, latency,
+  ``collective::<op>`` spans + ``collective_*`` metrics), and the
+  :class:`HangWatchdog` publishes per-rank progress heartbeats over
+  the TCPStore, localizes cross-rank hangs (desync report naming the
+  lagging rank and the first divergent seq/op) and dumps atomic debug
+  bundles; the telemetry server's ``/flight`` endpoint and the
+  ``TrainingSupervisor``'s ``on_hang`` escalation ride it.
 - the step-aware :class:`~paddle_tpu.profiler.Profiler` (re-exported
   here lazily to avoid an import cycle): ``make_scheduler`` windows,
   step-boundary instant events, and registry gauges emitted as
@@ -54,6 +63,7 @@ from __future__ import annotations
 from .aggregate import (  # noqa: F401
     ClusterAggregator,
     RankMetricsPublisher,
+    StorePublisher,
 )
 from .compile_watchdog import (  # noqa: F401
     CompileWatchdog,
@@ -67,6 +77,14 @@ from .exporter import (  # noqa: F401
     ResourceSampler,
     TelemetryServer,
     start_telemetry_server,
+)
+from .flight import (  # noqa: F401
+    CollectiveRecord,
+    FlightRecorder,
+    HangWatchdog,
+    default_flight_recorder,
+    record_collective,
+    use_flight_recorder,
 )
 from .goodput import (  # noqa: F401
     PEAK_FLOPS,
@@ -100,7 +118,10 @@ __all__ = [
     "ResourceSampler", "TelemetryServer", "start_telemetry_server",
     "GoodputMonitor", "PEAK_FLOPS", "device_peak_flops", "mfu",
     "HealthMonitor", "TrainingHealthError",
-    "RankMetricsPublisher", "ClusterAggregator",
+    "RankMetricsPublisher", "ClusterAggregator", "StorePublisher",
+    "CollectiveRecord", "FlightRecorder", "HangWatchdog",
+    "default_flight_recorder", "use_flight_recorder",
+    "record_collective",
     # lazy (profiler leg)
     "Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
     "export_chrome_tracing",

@@ -36,40 +36,47 @@ import time
 
 from .metrics import _fmt_labels, _prom_line, _prom_name, default_registry
 
-__all__ = ["RankMetricsPublisher", "ClusterAggregator"]
+__all__ = ["StorePublisher", "RankMetricsPublisher", "ClusterAggregator"]
 
 
 def _rank_key(prefix, rank):
     return f"{prefix}/rank_{int(rank)}"
 
 
-class RankMetricsPublisher:
-    """Publish this rank's registry snapshot into the TCPStore.
+class StorePublisher:
+    """Publish a JSON payload under one TCPStore key, now or on a timer.
 
-    ``publish()`` pushes one snapshot now; ``start(interval_s)`` runs a
-    daemon thread doing so periodically (strictly opt-in — constructing
-    a publisher touches nothing).  The payload carries a wall-clock
-    stamp the aggregator uses for staleness, so publisher and
-    aggregator clocks must be comparable (NTP-synced hosts; tests
-    inject clocks)."""
+    The shared machinery behind every per-rank publisher riding the
+    rendezvous plane (metric snapshots here, the flight recorder's hang
+    heartbeats in :mod:`.flight`): one key per rank overwritten in
+    place, ``publish()`` for a one-shot push, ``start(interval_s)`` for
+    a daemon thread that calls :meth:`tick` periodically and survives a
+    flaky store.  Strictly opt-in — constructing a publisher touches
+    nothing.  Subclasses implement :meth:`payload` (and may override
+    :meth:`tick` to do more than publish per beat)."""
 
-    def __init__(self, store, rank, registry=None, key_prefix="metrics",
-                 clock=None):
+    thread_name = "store-publisher"
+
+    def __init__(self, store, key, clock=None):
         self.store = store
-        self.rank = int(rank)
-        self.registry = registry or default_registry()
-        self.key = _rank_key(key_prefix, rank)
+        self.key = key
         self._clock = clock or time.time
         self._thread = None
         self._stop = threading.Event()
         self.published = 0
 
+    def payload(self):
+        raise NotImplementedError
+
     def publish(self):
-        payload = {"rank": self.rank, "time": self._clock(),
-                   "metrics": self.registry.snapshot()}
+        payload = self.payload()
         self.store.set(self.key, json.dumps(payload))
         self.published += 1
         return payload
+
+    def tick(self):
+        """One timer beat (the thread's body); default = one publish."""
+        self.publish()
 
     # ---- thread ---------------------------------------------------------
     def start(self, interval_s=5.0):
@@ -78,14 +85,18 @@ class RankMetricsPublisher:
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, args=(float(interval_s),),
-            name=f"metrics-publisher-{self.rank}", daemon=True)
+            name=self.thread_name, daemon=True)
         self._thread.start()
         return self
+
+    @property
+    def running(self):
+        return self._thread is not None
 
     def _run(self, interval_s):
         while not self._stop.is_set():
             try:
-                self.publish()
+                self.tick()
             except Exception:
                 pass            # a flaky store must not kill training
             self._stop.wait(interval_s)
@@ -103,6 +114,25 @@ class RankMetricsPublisher:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+class RankMetricsPublisher(StorePublisher):
+    """Publish this rank's registry snapshot into the TCPStore.
+
+    The payload carries a wall-clock stamp the aggregator uses for
+    staleness, so publisher and aggregator clocks must be comparable
+    (NTP-synced hosts; tests inject clocks)."""
+
+    def __init__(self, store, rank, registry=None, key_prefix="metrics",
+                 clock=None):
+        super().__init__(store, _rank_key(key_prefix, rank), clock=clock)
+        self.rank = int(rank)
+        self.registry = registry or default_registry()
+        self.thread_name = f"metrics-publisher-{self.rank}"
+
+    def payload(self):
+        return {"rank": self.rank, "time": self._clock(),
+                "metrics": self.registry.snapshot()}
 
 
 def _scalar_of(value):

@@ -16,11 +16,17 @@ enforces that):
                 labelled ``rank="<r>"``, one scrape for the whole job
   ``/varz``     JSON registry snapshot + compile-watchdog report (plus
                 the fleet ``cluster`` view when aggregating)
-  ``/healthz``  serving health: healthy flag, queue depth, page
-                occupancy, and the engine's ``estimated_drain_s``
-                (HTTP 503 while shedding — load balancers eject on
-                status alone)
+  ``/healthz``  one probe for BOTH serving and training liveness:
+                serving shedding state (queue depth, page occupancy,
+                ``estimated_drain_s``), the ``training_healthy`` gauge
+                and the hang-watchdog state — HTTP 503 while shedding,
+                while training is anomalous, or during an active
+                cross-rank hang (load balancers and fleet supervisors
+                eject on status alone)
   ``/traces``   recent completed traces from the Tracer (``?limit=N``)
+  ``/flight``   the distributed flight recorder: collective-ring
+                summary + newest records, in-flight collectives, and
+                the hang watchdog's last desync report / bundle paths
   ===========  ========================================================
 
   ``port=0`` binds an ephemeral port (read it back from
@@ -220,6 +226,8 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                 limit = int(q["limit"][0]) if "limit" in q else None
                 self._send(200, json.dumps(
                     {"traces": srv.tracer.traces(limit=limit)}))
+            elif url.path == "/flight":
+                self._send(200, json.dumps(srv.flightz(), default=str))
             else:
                 self._send(404, json.dumps({"error": "not found",
                                             "path": url.path}))
@@ -238,13 +246,15 @@ class TelemetryServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, registry, tracer, engine, watchdog,
-                 aggregator=None):
+                 aggregator=None, flight=None, hang=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
         self.engine = engine
         self.watchdog = watchdog
         self.aggregator = aggregator
+        self.flight = flight
+        self.hang = hang
         self._serve_thread = None
 
     # ---- payload builders ----------------------------------------------
@@ -262,23 +272,61 @@ class TelemetryServer(ThreadingHTTPServer):
         return out
 
     def healthz(self):
-        """Live serving health.  With an engine attached its
-        ``health()`` is authoritative; otherwise fall back to the
-        serving gauges in the registry (a scraper still gets the
-        shedding flag + drain estimate published by ``Engine.step``)."""
-        if self.engine is not None:
-            return self.engine.health()
-
+        """Live health — ONE probe for serving and training.  The
+        serving leg: with an engine attached its ``health()`` is
+        authoritative, otherwise the serving gauges in the registry.
+        Folded on top: the ``training_healthy`` gauge (HealthMonitor)
+        and the hang-watchdog state (attached watchdog, else the
+        ``hang_watchdog_active`` gauge).  An absent signal (no trainer
+        in this process, no watchdog) reads as healthy — the probe
+        degrades to exactly what the process actually runs."""
         def gauge_value(name):
             m = self.registry.get(name)
             return m.value if m is not None and m.kind == "gauge" else None
 
-        healthy = gauge_value("serving_engine_healthy")
-        return {"healthy": bool(healthy) if healthy is not None else True,
-                "queue_depth": gauge_value("serving_queue_depth"),
-                "page_occupancy": gauge_value("serving_page_occupancy"),
-                "estimated_drain_s":
-                    gauge_value("serving_estimated_drain_seconds")}
+        if self.engine is not None:
+            out = dict(self.engine.health())
+        else:
+            healthy = gauge_value("serving_engine_healthy")
+            out = {"healthy": bool(healthy) if healthy is not None
+                   else True,
+                   "queue_depth": gauge_value("serving_queue_depth"),
+                   "page_occupancy":
+                       gauge_value("serving_page_occupancy"),
+                   "estimated_drain_s":
+                       gauge_value("serving_estimated_drain_seconds")}
+        training = gauge_value("training_healthy")
+        training = bool(training) if training is not None else None
+        if self.hang is not None:
+            hang_active = bool(self.hang.hang_active)
+        else:
+            g = gauge_value("hang_watchdog_active")
+            hang_active = bool(g) if g is not None else None
+        out["training_healthy"] = training
+        out["hang_active"] = hang_active
+        out["healthy"] = (bool(out.get("healthy", True))
+                          and training is not False
+                          and not hang_active)
+        return out
+
+    def flightz(self):
+        """The ``/flight`` payload: collective-ring summary + newest
+        records and, with a hang watchdog attached, its state and last
+        desync report."""
+        from .flight import default_flight_recorder
+
+        rec = self.flight if self.flight is not None \
+            else default_flight_recorder()
+        out = {"summary": rec.summary(),
+               "records": rec.records(limit=64),
+               "inflight": rec.inflight()}
+        if self.hang is not None:
+            out["hang"] = {"active": bool(self.hang.hang_active),
+                           "fired": self.hang.fired,
+                           "desync": self.hang.last_desync,
+                           "bundles": [os.fspath(p)
+                                       for p in self.hang.bundles]}
+        return out
 
     # ---- lifecycle ------------------------------------------------------
     @property
@@ -313,7 +361,7 @@ class TelemetryServer(ThreadingHTTPServer):
 
 def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                            tracer=None, engine=None, watchdog=None,
-                           aggregator=None):
+                           aggregator=None, flight=None, hang=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -324,8 +372,13 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     else the process-wide :func:`default_tracer`.  ``aggregator`` (an
     :class:`~paddle_tpu.observability.aggregate.ClusterAggregator`,
     rank-0 only) switches ``/metrics`` to the merged fleet exposition
-    and embeds the ``cluster`` view in ``/varz``.  Never called on
-    import anywhere in the framework — telemetry is strictly opt-in.
+    and embeds the ``cluster`` view in ``/varz``.  ``flight`` (a
+    :class:`~paddle_tpu.observability.flight.FlightRecorder`, default:
+    the process-wide one) backs ``/flight``; ``hang`` (a
+    :class:`~paddle_tpu.observability.flight.HangWatchdog`) adds its
+    desync/bundle state there and makes ``/healthz`` go 503 during an
+    active cross-rank hang.  Never called on import anywhere in the
+    framework — telemetry is strictly opt-in.
     """
     if tracer is None:
         tracer = (engine.tracer if engine is not None
@@ -333,5 +386,6 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                   else default_tracer())
     srv = TelemetryServer((host, int(port)),
                           registry or default_registry(), tracer,
-                          engine, watchdog, aggregator=aggregator)
+                          engine, watchdog, aggregator=aggregator,
+                          flight=flight, hang=hang)
     return srv._start()

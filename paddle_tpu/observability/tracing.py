@@ -198,6 +198,16 @@ class Tracer:
                                     self.max_traces]
 
     # ---- readers --------------------------------------------------------
+    def live_spans(self):
+        """Open (in-flight) spans across live traces, as dicts — what a
+        hung process was in the middle of.  The hang watchdog's debug
+        bundle carries these: a crash-truncated trace never reaches the
+        completed ring, so the live view is the only record."""
+        with self._lock:
+            return [s.to_dict()
+                    for spans in self._live.values()
+                    for s in spans if not s.ended]
+
     def traces(self, limit=None):
         """Completed traces (oldest → newest), each a JSON-able dict;
         ``limit`` keeps only the newest N."""

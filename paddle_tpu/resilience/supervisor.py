@@ -28,8 +28,20 @@ child is alive then, so the constructor's orphan-``.tmp`` sweep is
 safe) and logs the step it expects the relaunch to resume from — the
 operator-readable audit trail of an operation no operator performed.
 
+With a ``hang_watchdog`` attached (a
+:class:`~paddle_tpu.observability.flight.HangWatchdog` in observer
+mode, or any object with ``check()``/``write_bundle()``/``reset()``),
+the supervisor also escalates on **cross-rank collective hangs**: a
+wedged child never exits, so exit-code watching alone would wait
+forever.  ``on_hang="bundle+restart"`` (the default) dumps a
+supervisor-side debug bundle, terminates the hung child and re-enters
+the relaunch path (reason ``hang``); ``on_hang="restart"`` skips the
+bundle.  The watchdog is ``reset()`` after the kill so the relaunched
+fleet re-baselines instead of re-firing on the dead run's stale
+heartbeats.
+
 Telemetry: ``supervisor_restarts_total{reason=elastic_exit|crash|
-lost_node|spawn_failed}``, the ``supervisor_child_up`` gauge, and
+lost_node|spawn_failed|hang}``, the ``supervisor_child_up`` gauge, and
 ``supervisor::launch`` / ``supervisor::relaunch`` trace spans.
 
 Fault sites (see :mod:`.faults`): ``supervisor.spawn`` fires before
@@ -83,7 +95,8 @@ class TrainingSupervisor:
                  elastic=None, hosts=(), poll_interval=0.05,
                  membership_interval=0.5, rendezvous_timeout=60.0,
                  term_grace_s=10.0, env=None, log_path=None, rng=None,
-                 registry=None, tracer=None):
+                 registry=None, tracer=None, hang_watchdog=None,
+                 on_hang="bundle+restart"):
         self.cmd = list(cmd)
         self.checkpoint_dir = checkpoint_dir
         self.max_restarts = int(max_restarts)
@@ -101,6 +114,10 @@ class TrainingSupervisor:
         self._rng = rng
         self._registry = registry
         self._tracer = tracer
+        self.hang_watchdog = hang_watchdog
+        if on_hang not in ("bundle+restart", "restart"):
+            raise ValueError(f"unknown on_hang policy {on_hang!r}")
+        self.on_hang = on_hang
         self.attempt = 0            # current launch attempt (0 = first)
         self.restarts = []          # [(reason, attempt)] audit log
 
@@ -221,9 +238,38 @@ class TrainingSupervisor:
             return []
 
     # ---- the loop -------------------------------------------------------
+    def _hang_detected(self):
+        """Probe the attached hang watchdog (False without one, and on
+        probe errors — a broken watchdog must not kill a healthy
+        child)."""
+        if self.hang_watchdog is None:
+            return False
+        try:
+            return bool(self.hang_watchdog.check())
+        except Exception:
+            return False
+
+    def _escalate_hang(self, child):
+        """The ``on_hang`` escalation: dump (policy permitting), kill
+        the wedged child, reset the watchdog for the relaunch."""
+        logger.error("supervisor: cross-rank hang detected — "
+                     "escalating with policy %r", self.on_hang)
+        if "bundle" in self.on_hang:
+            try:
+                self.hang_watchdog.write_bundle(reason="supervisor_hang")
+            except Exception:
+                logger.exception("supervisor: hang bundle write failed")
+        self._terminate(child)
+        self._child_up(False)
+        try:
+            self.hang_watchdog.reset()
+        except Exception:
+            pass
+
     def _watch(self, child):
-        """Block until the child exits or membership breaks.  Returns
-        ``("ok"|"elastic_exit"|"crash"|"lost_node", exit_code)``."""
+        """Block until the child exits, membership breaks, or the hang
+        watchdog fires.  Returns ``("ok"|"elastic_exit"|"crash"|
+        "lost_node"|"hang", exit_code)``."""
         elastic_code = self._elastic_exit_code()
         next_probe = time.monotonic() + self.membership_interval
         while True:
@@ -235,16 +281,19 @@ class TrainingSupervisor:
                 if code == elastic_code:
                     return ("elastic_exit", code)
                 return ("crash", code)
-            if self.elastic is not None and self.hosts and \
-                    time.monotonic() >= next_probe:
-                dead = self._membership_lost()
-                if dead:
-                    logger.warning("supervisor: lost node(s) %s — "
-                                   "terminating local trainer for "
-                                   "relaunch", dead)
-                    self._terminate(child)
-                    self._child_up(False)
-                    return ("lost_node", elastic_code)
+            if time.monotonic() >= next_probe:
+                if self.elastic is not None and self.hosts:
+                    dead = self._membership_lost()
+                    if dead:
+                        logger.warning("supervisor: lost node(s) %s — "
+                                       "terminating local trainer for "
+                                       "relaunch", dead)
+                        self._terminate(child)
+                        self._child_up(False)
+                        return ("lost_node", elastic_code)
+                if self._hang_detected():
+                    self._escalate_hang(child)
+                    return ("hang", elastic_code)
                 next_probe = time.monotonic() + self.membership_interval
             time.sleep(self.poll_interval)
 
