@@ -479,6 +479,136 @@ def bench_serving(n_requests=24, rate_per_s=8.0, max_new=32, seed=0):
     return out
 
 
+def bench_fleet(n_requests=30, rate_per_s=12.0, max_new=16, n_replicas=3,
+                seed=0):
+    """Serving-fleet failover scenario: replay a recorded Poisson
+    arrival trace through ``n_replicas`` in-process engines behind a
+    FleetRouter, hard-kill one replica mid-trace (then relaunch it),
+    and roll-restart another under a drain deadline — measuring what
+    fleet-level robustness costs:
+
+    - ``fleet_tokens_per_sec`` — goodput across the surviving fleet;
+    - ``failover_added_ttft_p95_ms`` — TTFT p95 of requests that were
+      re-dispatched off a dead/drained replica minus the p95 of
+      untouched requests (the latency price of exactly-once recovery);
+    - ``lost_requests`` — requests not FINISHED at trace end.  The
+      zero-loss contract: this MUST be 0.
+    """
+    import dataclasses
+
+    import jax
+
+    from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
+    from paddle_tpu.serving import Engine, FleetRouter, SamplingParams
+
+    on_tpu = jax.devices()[0].platform not in ("cpu", "gpu", "cuda")
+    name = "gpt2-small" if on_tpu else "tiny"
+    cfg = dataclasses.replace(GPT_CONFIGS[name], dtype="bfloat16")
+    params = gpt_init(cfg, jax.random.key(0))
+
+    def factory():
+        return Engine(cfg, params, page_size=16,
+                      num_pages=1024 if on_tpu else 256,
+                      max_batch_size=4, chunk_len=min(32, cfg.max_seq_len))
+
+    # each replica engine compiles its own unified_step (separate jit
+    # closures, as separate processes would); that is not a recompile
+    # bug, so this section keeps the fleet out of watchdog telemetry
+    from paddle_tpu.observability.compile_watchdog import default_watchdog
+
+    wd = default_watchdog()
+    wd_prev, wd.enabled = wd.enabled, False
+    try:
+        warm = SamplingParams(max_new_tokens=2)
+        router = FleetRouter(
+            [factory] * n_replicas, stall_timeout_s=5.0,
+            drain_deadline_s=0.5,
+            # a restarted replica re-enters rotation warm (compiled)
+            warmup=lambda eng: eng.generate([[1, 2, 3]], warm))
+        for rep in router.replicas:          # compile before the clock
+            rep.engine.generate([[1, 2, 3]], warm)
+
+        rng = np.random.RandomState(seed)
+        arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, n_requests))
+        max_prompt = min(48, cfg.max_seq_len - max_new)
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               rng.randint(8, max_prompt)).tolist()
+                   for _ in range(n_requests)]
+        sp = SamplingParams(max_new_tokens=max_new)
+        kill_at, relaunch_at, drain_at = (n_requests // 3,
+                                          n_requests // 2,
+                                          2 * n_requests // 3)
+        log(f"[fleet] {name}: {n_replicas} replicas, {n_requests} "
+            f"requests Poisson {rate_per_s}/s; kill replica 0 at "
+            f"#{kill_at}, relaunch at #{relaunch_at}, rolling-restart "
+            f"replica 1 at #{drain_at}")
+
+        reqs, events = [], []
+        t0 = time.perf_counter()
+        i = 0
+        while i < n_requests or router.has_work():
+            now = time.perf_counter() - t0
+            while i < n_requests and arrivals[i] <= now:
+                reqs.append(router.submit(prompts[i], sp))
+                i += 1
+                if i == kill_at:
+                    router.kill_replica(0)
+                    events.append({"at_request": i, "event": "kill",
+                                   "replica": 0})
+                elif i == relaunch_at:
+                    router.restart_replica(0)
+                    events.append({"at_request": i, "event": "relaunch",
+                                   "replica": 0})
+                elif i == drain_at:
+                    router.drain(1, deadline_s=0.5)
+                    events.append({"at_request": i, "event": "drain",
+                                   "replica": 1})
+            if not router.has_work():
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+                continue
+            router.step()
+        wall = time.perf_counter() - t0
+    finally:
+        wd.enabled = wd_prev
+
+    lost = [r for r in reqs if r.state != "finished"]
+    tokens = sum(len(r.tokens_out) for r in reqs)
+
+    def p95_ms(ttfts):
+        return (float(np.percentile(ttfts, 95)) * 1e3 if ttfts else None)
+
+    clean = [r.t_first_token - r.t_submit for r in reqs
+             if r.redispatches == 0 and r.t_first_token is not None]
+    moved = [r.t_first_token - r.t_submit for r in reqs
+             if r.redispatches > 0 and r.t_first_token is not None]
+    snap = router.metrics.snapshot()
+    out = {
+        "model": name, "replicas": n_replicas, "requests": n_requests,
+        "wall_s": wall,
+        "fleet_tokens_per_sec": tokens / wall,
+        "lost_requests": len(lost),
+        "finished": sum(1 for r in reqs if r.state == "finished"),
+        "redispatched_requests": sum(1 for r in reqs
+                                     if r.redispatches > 0),
+        "ttft_p95_ms_clean": p95_ms(clean),
+        "ttft_p95_ms_failover": p95_ms(moved),
+        "failover_added_ttft_p95_ms": (
+            p95_ms(moved) - p95_ms(clean)
+            if clean and moved else None),
+        "events": events,
+        "router": snap,
+    }
+    assert out["lost_requests"] == 0, \
+        f"fleet lost {out['lost_requests']} requests: zero-loss contract"
+    log(f"[fleet] {out['fleet_tokens_per_sec']:.1f} tok/s over "
+        f"{n_replicas} replicas, {out['finished']}/{n_requests} "
+        f"finished, lost {out['lost_requests']}, "
+        f"{out['redispatched_requests']} redispatched; TTFT p95 "
+        f"{out['ttft_p95_ms_clean'] or 0:.0f}ms clean vs "
+        f"{out['ttft_p95_ms_failover'] or 0:.0f}ms failover")
+    return out
+
+
 def bench_ps(rows=100_000, dim=64, batch=4096):
     """Sparse parameter-server scale check: a 100k-row table pulled and
     pushed through the PSClient in loader-sized batches, reporting
@@ -801,7 +931,8 @@ def main():
     ap.add_argument("--no-serving", action="store_true")
     ap.add_argument("--section",
                     choices=["gpt", "rung", "flash", "resnet", "ps",
-                             "serving", "resilience", "distributed"],
+                             "serving", "fleet", "resilience",
+                             "distributed"],
                     help="internal: run ONE section in-process, print "
                          "its JSON")
     ap.add_argument("--rung", type=int, default=0,
@@ -841,6 +972,9 @@ def main():
         return
     if args.section == "serving":
         print(json.dumps(_section_telemetry(bench_serving())))
+        return
+    if args.section == "fleet":
+        print(json.dumps(_section_telemetry(bench_fleet())))
         return
     if args.section == "resilience":
         print(json.dumps(_section_telemetry(bench_resilience())))
@@ -903,6 +1037,8 @@ def main():
     if not args.no_serving:
         extra["serving"] = _run_section(["--section", "serving"],
                                         timeout_s=1500, tag="serving")
+        extra["fleet"] = _run_section(["--section", "fleet"],
+                                      timeout_s=1500, tag="fleet")
     extra["resilience"] = _run_section(["--section", "resilience"],
                                        timeout_s=600, tag="resilience")
     extra["distributed"] = _run_section(["--section", "distributed"],

@@ -27,6 +27,10 @@ enforces that):
   ``/flight``   the distributed flight recorder: collective-ring
                 summary + newest records, in-flight collectives, and
                 the hang watchdog's last desync report / bundle paths
+  ``/fleet``    the serving fleet router: per-replica state (breaker,
+                drain, backpressure window, live engine health) and
+                the ``router_*`` counters — 404 when no router is
+                attached
   ===========  ========================================================
 
   ``port=0`` binds an ephemeral port (read it back from
@@ -228,6 +232,13 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                     {"traces": srv.tracer.traces(limit=limit)}))
             elif url.path == "/flight":
                 self._send(200, json.dumps(srv.flightz(), default=str))
+            elif url.path == "/fleet":
+                if srv.router is None:
+                    self._send(404, json.dumps(
+                        {"error": "no fleet router attached"}))
+                else:
+                    self._send(200, json.dumps(srv.router.fleet_status(),
+                                               default=str))
             else:
                 self._send(404, json.dumps({"error": "not found",
                                             "path": url.path}))
@@ -246,7 +257,7 @@ class TelemetryServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, registry, tracer, engine, watchdog,
-                 aggregator=None, flight=None, hang=None):
+                 aggregator=None, flight=None, hang=None, router=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
@@ -255,6 +266,7 @@ class TelemetryServer(ThreadingHTTPServer):
         self.aggregator = aggregator
         self.flight = flight
         self.hang = hang
+        self.router = router
         self._serve_thread = None
 
     # ---- payload builders ----------------------------------------------
@@ -273,18 +285,24 @@ class TelemetryServer(ThreadingHTTPServer):
 
     def healthz(self):
         """Live health — ONE probe for serving and training.  The
-        serving leg: with an engine attached its ``health()`` is
-        authoritative, otherwise the serving gauges in the registry.
-        Folded on top: the ``training_healthy`` gauge (HealthMonitor)
-        and the hang-watchdog state (attached watchdog, else the
-        ``hang_watchdog_active`` gauge).  An absent signal (no trainer
-        in this process, no watchdog) reads as healthy — the probe
-        degrades to exactly what the process actually runs."""
+        serving leg: with a fleet router attached its
+        ``fleet_health()`` is authoritative — 503 only when NO replica
+        can admit (all breakers open or draining); one replica merely
+        shedding is soft backpressure, not an outage.  Otherwise an
+        attached engine's ``health()``, else the serving gauges in the
+        registry.  Folded on top: the ``training_healthy`` gauge
+        (HealthMonitor) and the hang-watchdog state (attached
+        watchdog, else the ``hang_watchdog_active`` gauge).  An absent
+        signal (no trainer in this process, no watchdog) reads as
+        healthy — the probe degrades to exactly what the process
+        actually runs."""
         def gauge_value(name):
             m = self.registry.get(name)
             return m.value if m is not None and m.kind == "gauge" else None
 
-        if self.engine is not None:
+        if self.router is not None:
+            out = dict(self.router.fleet_health())
+        elif self.engine is not None:
             out = dict(self.engine.health())
         else:
             healthy = gauge_value("serving_engine_healthy")
@@ -361,7 +379,8 @@ class TelemetryServer(ThreadingHTTPServer):
 
 def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                            tracer=None, engine=None, watchdog=None,
-                           aggregator=None, flight=None, hang=None):
+                           aggregator=None, flight=None, hang=None,
+                           router=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -377,15 +396,21 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     the process-wide one) backs ``/flight``; ``hang`` (a
     :class:`~paddle_tpu.observability.flight.HangWatchdog`) adds its
     desync/bundle state there and makes ``/healthz`` go 503 during an
-    active cross-rank hang.  Never called on import anywhere in the
+    active cross-rank hang.  ``router`` (a
+    :class:`~paddle_tpu.serving.FleetRouter`) serves ``/fleet`` and
+    switches the ``/healthz`` serving leg to the fleet fold: 503 only
+    when no replica can admit.  Never called on import anywhere in the
     framework — telemetry is strictly opt-in.
     """
     if tracer is None:
-        tracer = (engine.tracer if engine is not None
-                  and getattr(engine, "tracer", None) is not None
-                  else default_tracer())
+        if engine is not None and getattr(engine, "tracer", None):
+            tracer = engine.tracer
+        elif router is not None and getattr(router, "tracer", None):
+            tracer = router.tracer
+        else:
+            tracer = default_tracer()
     srv = TelemetryServer((host, int(port)),
                           registry or default_registry(), tracer,
                           engine, watchdog, aggregator=aggregator,
-                          flight=flight, hang=hang)
+                          flight=flight, hang=hang, router=router)
     return srv._start()

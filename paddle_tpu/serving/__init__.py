@@ -47,14 +47,63 @@ Drain-estimate contract: every RETRY_AFTER request carries
 ``Request.retry_after_s`` — a finite, strictly positive number of
 seconds derived from the live backlog (queued + running decode tokens
 still owed) divided by the engine's EWMA decode rate
-(``Engine.estimated_drain_s()``).  The same figure is published as the
+(``Engine.estimated_drain_s()``).  Before the EWMA has its first real
+sample the estimate never reports below the configurable
+``drain_floor_s`` cold-start floor (default ``Engine.DRAIN_FLOOR_S``),
+so a freshly (re)started replica is never advertised as instantly
+drainable.  The same figure is published as the
 ``serving_estimated_drain_seconds`` gauge and on the telemetry server's
 ``/healthz`` (README "Flight recorder"), so front-ends and fleet
 schedulers back off by measured drain time, not a guessed constant.
 Every request is additionally traced
 queued→chunk[i]→decode[i]→terminal through ``Engine.tracer``
 (chrome-trace / JSON exportable).
+
+Fleet-router contract (:mod:`router` — README "Serving fleet"): a
+:class:`FleetRouter` over N replica engines is the fleet-level
+robustness unit.  Semantics it guarantees:
+
+- **drain-based balancing** — each admission goes to the admittable
+  replica with the lowest ``estimated_drain_s`` (queue depth + running
+  count break ties), so backlog self-levels across the fleet.
+- **bounded backpressure** — a replica's RETRY_AFTER closes its
+  admission window for ``max(retry_after_s, jittered exponential
+  delay)`` capped at ``backoff_cap_s`` (``resilience.retry``'s
+  full-jitter generator); the window resets on the next successful
+  dispatch.  The router never hammers a shedding replica and never
+  abandons it either.
+- **circuit breaker** — ``breaker_threshold`` failures (OSError from
+  step/admit/probe, an admission stall over ``stall_timeout_s`` wall
+  time, or ``probe_miss_threshold`` missed health probes) open the
+  replica's breaker: out of rotation until restarted.
+- **idempotent re-enqueue (zero loss)** — on failover or drain
+  deadline, every in-flight request moves back to the router queue
+  head *exactly once per event*, re-dispatched as an ordinary
+  admission of ``prompt + harvested tokens``; KV state is rebuilt,
+  never trusted, only completed-step tokens count as emitted, so
+  greedy output is token-identical to an un-failed run and nothing is
+  emitted twice.
+- **rolling restarts** — ``drain(rid)`` stops admissions, lets decode
+  finish within ``drain_deadline_s`` (stragglers re-dispatched), then
+  rebuilds the engine from its factory and re-enters rotation.
+- **fleet health fold** — ``/healthz`` (with the router attached to
+  the telemetry server) is 503 only when NO replica can admit: all
+  breakers open or draining.  One shedding replica is soft
+  backpressure, not an outage.
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
 from .kv_cache import PagedKVCache  # noqa: F401
-from .metrics import Counter, Gauge, Histogram, ServingMetrics  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    RouterMetrics,
+    ServingMetrics,
+)
+from .router import (  # noqa: F401
+    FleetRequest,
+    FleetRequestState,
+    FleetRouter,
+    Replica,
+    ReplicaState,
+)

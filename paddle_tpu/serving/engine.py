@@ -84,6 +84,7 @@ from ..models.gpt import GPTConfig, gpt_init, gpt_ragged_step
 from ..observability.compile_watchdog import watch
 from ..observability.tracing import Tracer, default_tracer
 from ..profiler.profiler import RecordEvent
+from ..resilience.faults import fault_point
 from .kv_cache import PagedKVCache
 from .metrics import ServingMetrics
 
@@ -97,6 +98,8 @@ class RequestState:
     REJECTED = "rejected"      # hard: can never be served (infeasible)
     RETRY_AFTER = "retry_after"  # soft: shed under load, resubmit later
     EVICTED = "evicted"        # deadline/TTL passed before completion
+    EVACUATED = "evacuated"    # pulled off a failed/draining replica; the
+    #                            fleet router re-enqueues it elsewhere
 
 
 @dataclasses.dataclass
@@ -170,6 +173,14 @@ class Engine:
     SamplingParams doesn't set one.  ``shed_occupancy_high/low`` (pool
     fraction, 0..1) and ``shed_queue_high/low`` (queue depth) arm
     watermark load shedding; lows default to 3/4 of their high.
+    ``drain_floor_s`` is the cold-start floor on the drain estimate:
+    until the decode-rate EWMA has its first real sample the engine
+    cannot know how fast it drains, so ``estimated_drain_s()`` (and
+    the ``retry_after_s`` hint built on it) never reports below this
+    floor — a freshly (re)started replica advertises "give me a
+    moment" instead of a useless 0 that would invite the whole fleet's
+    backlog at once.  Once a decode step has measured the real rate
+    the floor no longer applies.
     ``clock`` replaces time.perf_counter (tests drive a manual clock so
     deadline behavior is deterministic, not sleep-based).  ``tracer``
     overrides the flight recorder; by default the engine records into
@@ -183,12 +194,17 @@ class Engine:
     #: estimate of a request shed before any decoding happened
     ASSUMED_DECODE_RATE = 100.0
 
+    #: default cold-start floor (seconds) on the drain estimate while
+    #: the decode-rate EWMA has no sample yet
+    DRAIN_FLOOR_S = 0.5
+
     def __init__(self, cfg: GPTConfig, params=None, *, page_size=16,
                  num_pages=256, max_batch_size=4, chunk_len=None,
                  token_budget=None, prefill_len=None,
                  default_ttl_s=None, shed_occupancy_high=None,
                  shed_occupancy_low=None, shed_queue_high=None,
-                 shed_queue_low=None, clock=None, tracer=None):
+                 shed_queue_low=None, drain_floor_s=None,
+                 clock=None, tracer=None):
         self.cfg = cfg
         self._clock = clock or time.perf_counter
         if tracer is None:
@@ -198,6 +214,8 @@ class Engine:
         self._decode_rate_ewma = None     # tok/s, None until first decode
         self._ewma_alpha = 0.25
         self.default_ttl_s = default_ttl_s
+        self.drain_floor_s = (self.DRAIN_FLOOR_S if drain_floor_s is None
+                              else float(drain_floor_s))
         self.shed_occupancy_high = shed_occupancy_high
         self.shed_occupancy_low = (
             shed_occupancy_low if shed_occupancy_low is not None
@@ -253,6 +271,10 @@ class Engine:
         state is REJECTED immediately when it can never be served, and
         a shed request carries ``retry_after_s`` (the live drain
         estimate) next to its RETRY_AFTER state."""
+        # fault site: a stall here is an admission wedge (the RPC thread
+        # of a real deployment hanging in submit); an io_error is the
+        # transport refusing the request.  The fleet router detects both.
+        fault_point("serving.admit")
         sampling = sampling or SamplingParams()
         req = Request(id=self._next_id, prompt=list(prompt),
                       sampling=sampling, t_submit=self._clock())
@@ -349,13 +371,19 @@ class Engine:
     def estimated_drain_s(self):
         """Seconds to decode the current backlog at the measured rate —
         the machine-readable retry-after hint (ROADMAP: "estimated
-        drain time from queue depth × decode rate").  Falls back to
-        ASSUMED_DECODE_RATE before the first decode measurement."""
+        drain time from queue depth × decode rate").  Before the first
+        decode measurement the rate falls back to ASSUMED_DECODE_RATE
+        and the estimate never reports below ``drain_floor_s``: a
+        cold/freshly-restarted engine has no evidence it drains fast,
+        and advertising 0 would invite a router to dump the whole
+        fleet's backlog on it at once."""
         tokens = self.pending_decode_tokens()
+        if self._decode_rate_ewma is None:
+            assumed = tokens / self.ASSUMED_DECODE_RATE
+            return max(assumed, self.drain_floor_s)
         if tokens <= 0:
             return 0.0
-        rate = self._decode_rate_ewma or self.ASSUMED_DECODE_RATE
-        return tokens / max(rate, 1e-9)
+        return tokens / max(self._decode_rate_ewma, 1e-9)
 
     def _retry_after(self):
         """Finite, strictly positive back-off for a shed request: at
@@ -672,6 +700,10 @@ class Engine:
         run the unified ragged step (prompt chunks + decode rows in one
         batch), update gauges.  Returns requests that finished (or were
         evicted) this step."""
+        # fault site: an io_error here is the whole step failing the way
+        # a crashed replica's RPC would — before any request state
+        # mutates, so a router can re-dispatch losslessly
+        fault_point("serving.step")
         self._evict_expired()
         self._try_admit()
         self._unified_step_once(self._ensure_capacity())
@@ -681,6 +713,34 @@ class Engine:
         self.metrics.estimated_drain_s.set(self.estimated_drain_s())
         done, self._just_finished = self._just_finished, []
         return done
+
+    def evacuate(self):
+        """Pull EVERY in-flight request off this engine — running
+        (mid-prefill or decoding) and queued — free their pages, and
+        return them with their sampled tokens intact, in admission
+        order (running first, then the queue).
+
+        The fleet router's failover/drain primitive: the caller
+        re-enqueues each request elsewhere as an ordinary admission
+        (prompt + already-sampled tokens), so this engine's paged KV
+        state is never trusted again.  Each request leaves in state
+        ``EVACUATED`` with its trace closed; partial output is
+        preserved — nothing is re-sampled here, nothing is lost."""
+        now = self._clock()
+        running = sorted(self._running(), key=lambda r: r._admit_seq)
+        for req in running:
+            self.cache.free(req.id)
+            self._slots[self._slots.index(req)] = None
+        queued = list(self._queue)
+        self._queue.clear()
+        out = running + queued
+        for req in out:
+            req.state = RequestState.EVACUATED
+            req.finish_reason = "evacuated"
+            self._end_trace(req, end_s=now)
+        self.metrics.queue_depth.set(0)
+        self.metrics.page_occupancy.set(self.cache.occupancy())
+        return out
 
     def health(self):
         """Live scheduler health — the ``/healthz`` payload: shedding

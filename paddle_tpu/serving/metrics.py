@@ -31,7 +31,8 @@ from ..observability.metrics import (  # noqa: F401  (re-export compat)
     default_registry,
 )
 
-__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics"]
+__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics",
+           "RouterMetrics"]
 
 
 class ServingMetrics:
@@ -141,3 +142,83 @@ class ServingMetrics:
         lines.append(f"{'health':<16} "
                      f"{'healthy' if s['engine_healthy'] else 'degraded'}")
         return "\n".join(lines)
+
+
+class RouterMetrics:
+    """Fleet-router metric facade (``router_*`` series, per-replica
+    labels).  One instance per :class:`~paddle_tpu.serving.FleetRouter`;
+    like :class:`ServingMetrics` it registers into the default registry
+    with replace semantics unless an explicit registry is passed."""
+
+    def __init__(self, registry=None):
+        self.registry = default_registry() if registry is None else registry
+        reg = self.registry
+
+        def add(metric):
+            return reg.register(metric, replace=True)
+
+        self.dispatches = add(Counter(
+            "router_dispatches_total", labelnames=("replica",),
+            help="requests handed to a replica engine (re-dispatches "
+                 "after failover/drain included)"))
+        self.failovers = add(Counter(
+            "router_failovers_total", labelnames=("replica", "reason"),
+            help="replica failures that opened the circuit breaker and "
+                 "moved every in-flight request elsewhere"))
+        self.redispatched = add(Counter(
+            "router_redispatched_requests_total",
+            help="in-flight requests re-enqueued off a failed or "
+                 "drained replica (each exactly once per event)"))
+        self.backpressure_retries = add(Counter(
+            "router_backpressure_retries_total", labelnames=("replica",),
+            help="dispatches deferred because the replica answered "
+                 "RETRY_AFTER (router backs off by the drain hint)"))
+        self.drains = add(Counter(
+            "router_drains_total", labelnames=("replica",),
+            help="graceful drains started (rolling restarts)"))
+        self.restarts = add(Counter(
+            "router_replica_restarts_total", labelnames=("replica",),
+            help="replica engines rebuilt (post-drain or manual revive)"))
+        self.lost = add(Counter(
+            "router_requests_lost_total",
+            help="requests the router could not place or recover — "
+                 "MUST stay 0; anything else is a failover bug"))
+        self.breaker_open = add(Gauge(
+            "router_breaker_open", labelnames=("replica",),
+            help="1 = circuit breaker open (replica out of rotation)"))
+        self.replicas_admittable = add(Gauge(
+            "router_replicas_admittable",
+            help="replicas currently accepting new admissions"))
+        self.fleet_healthy = add(Gauge(
+            "router_fleet_healthy",
+            help="1 = at least one replica can admit (the /healthz "
+                 "fleet fold)"))
+        self.pending_depth = add(Gauge(
+            "router_pending_depth",
+            help="requests waiting in the router queue (not yet on "
+                 "any replica)"))
+        self.ttft = add(Histogram(
+            "router_ttft_seconds",
+            help="fleet-level submit -> first token, failover and "
+                 "backpressure delays included"))
+
+    @staticmethod
+    def _family(metric):
+        return {",".join(lv) or "": child.snapshot_value()
+                for lv, child in metric._series()}
+
+    def snapshot(self):
+        return {
+            "dispatches": self._family(self.dispatches),
+            "failovers": self._family(self.failovers),
+            "redispatched": self.redispatched.value,
+            "backpressure_retries": self._family(self.backpressure_retries),
+            "drains": self._family(self.drains),
+            "restarts": self._family(self.restarts),
+            "lost": self.lost.value,
+            "breaker_open": self._family(self.breaker_open),
+            "replicas_admittable": self.replicas_admittable.value,
+            "fleet_healthy": self.fleet_healthy.value,
+            "pending_depth": self.pending_depth.value,
+            "ttft_s": self.ttft.summary(),
+        }

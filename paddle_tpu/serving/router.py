@@ -1,0 +1,649 @@
+"""Fault-tolerant serving fleet — the multi-replica router.
+
+One engine (``serving/engine.py``) is fast; a fleet of them is only
+*survivable* if something above the replicas treats failure as routine.
+Since PR 3–4 every engine exports the router signals — health gauge,
+queue depth, ``estimated_drain_s``, soft ``RETRY_AFTER`` with a
+machine-readable back-off hint — and this module is their consumer:
+
+- **drain-based load balancing** — new admissions go to the replica
+  with the smallest ``estimated_drain_s`` (queue depth breaks ties),
+  so a slow or backlogged replica sheds traffic to its peers instead
+  of growing an unbounded queue.
+- **backpressure, not hammering** — a replica answering RETRY_AFTER is
+  put in a per-replica back-off window: ``max(retry_after_s hint,
+  jittered exponential delay)`` capped at ``backoff_cap_s`` (the delay
+  generator is :func:`paddle_tpu.resilience.retry.backoff_delays` —
+  the same full-jitter scheme every other blocking edge uses).  The
+  window resets on the next successful dispatch.
+- **failure detection + circuit breaker** — a replica fails by raising
+  ``OSError`` from ``step()``/``add_request()``/``health()`` (a real
+  deployment's RPC error; the ``serving.step`` io_error fault site
+  reproduces it deterministically), by wedging in admission (wall time
+  over ``stall_timeout_s``; the ``serving.admit`` stall site), or by
+  missing ``probe_miss_threshold`` consecutive health probes.  After
+  ``breaker_threshold`` failures the per-replica circuit breaker
+  opens: the replica leaves rotation (``router_breaker_open`` = 1)
+  until it is explicitly restarted.
+- **zero-loss failover** — when a breaker opens, every in-flight
+  request assigned to that replica is re-enqueued **exactly once** at
+  the head of the router queue, as an ordinary admission carrying
+  ``prompt + already-harvested tokens``.  The dead replica's paged KV
+  state is rebuilt elsewhere, never trusted; only tokens harvested
+  after a *completed* step count as emitted, so nothing is delivered
+  twice and greedy output stays token-identical to an un-failed run
+  (the engine's own recompute-parity guarantee, lifted to the fleet).
+- **graceful drain / rolling restart** — :meth:`FleetRouter.drain`
+  marks a replica draining: no new admissions, in-flight decode runs
+  to completion bounded by a drain deadline, stragglers are
+  re-dispatched exactly once, then the replica's engine is rebuilt
+  from its factory and re-enters rotation.  Restart a whole fleet one
+  replica at a time with zero dropped requests.
+
+Observability: ``router_*`` metrics (dispatches / failovers /
+backpressure retries / breaker state / restarts per replica, fleet
+TTFT histogram), tracer spans ``router::dispatch`` /
+``router::failover`` / ``router::drain``, and — with the router handed
+to :func:`~paddle_tpu.observability.exporter.start_telemetry_server` —
+a ``/fleet`` endpoint plus the ``/healthz`` fleet fold (503 only when
+*no* replica can admit).
+
+Clocks: scheduling (backpressure windows, drain deadlines, TTLs) reads
+the injectable ``clock``; stall detection always uses the real
+``time.perf_counter``, because an injected stall sleeps wall time no
+matter what the logical clock says.  Replica engines should share the
+router's clock so TTL hand-off across failover stays coherent.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from collections import deque
+
+from ..observability.tracing import Tracer, default_tracer
+from ..resilience.retry import backoff_delays
+from .engine import Engine, RequestState, SamplingParams
+from .metrics import RouterMetrics
+
+__all__ = ["FleetRouter", "FleetRequest", "FleetRequestState",
+           "Replica", "ReplicaState"]
+
+_wall = time.perf_counter      # stall detection is real elapsed time
+
+
+class ReplicaState:
+    HEALTHY = "healthy"        # in rotation (may be shedding — that's soft)
+    DRAINING = "draining"      # no new admissions; finishing in-flight work
+    DEAD = "dead"              # breaker open / drained-out; needs restart
+
+
+class FleetRequestState:
+    PENDING = "pending"        # in the router queue, on no replica
+    DISPATCHED = "dispatched"  # admitted to some replica's scheduler
+    FINISHED = "finished"
+    REJECTED = "rejected"      # infeasible on the replica that saw it
+    EVICTED = "evicted"        # fleet-level TTL passed
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """The router's view of one request across dispatches.
+
+    ``tokens_out`` holds every token *harvested* so far — synced from
+    the current replica after each successful step, and the only token
+    state that survives a failover (what a streaming front-end has
+    already sent downstream).  ``redispatches`` counts how many times
+    the request was pulled off a failed/drained replica; the zero-loss
+    tests assert it is exactly 1 per failure event."""
+
+    id: int
+    prompt: list
+    sampling: SamplingParams
+    state: str = FleetRequestState.PENDING
+    tokens_out: list = dataclasses.field(default_factory=list)
+    replica_id: int = None
+    finish_reason: str = None
+    dispatches: int = 0
+    redispatches: int = 0
+    t_submit: float = 0.0
+    t_first_token: float = None
+    t_finished: float = None
+    deadline: float = None       # router-clock absolute; None = no TTL
+    _engine_req: object = None   # Request on the current replica
+    _dispatch_base: int = 0      # len(tokens_out) when this dispatch began
+    _span: object = None         # root trace span
+
+    @property
+    def output(self):
+        return list(self.tokens_out)
+
+
+class Replica:
+    """One engine slot in the fleet: the live engine, its factory (how
+    a rolling restart rebuilds it), breaker/backpressure bookkeeping."""
+
+    def __init__(self, replica_id, engine, factory=None):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.factory = factory
+        self.state = ReplicaState.HEALTHY
+        self.consecutive_failures = 0
+        self.probe_misses = 0
+        self.not_before = 0.0          # backpressure window (router clock)
+        self.backoff = None            # lazy backoff_delays generator
+        self.drain_deadline = None
+        self.restart_after_drain = True
+        self._drain_span = None
+
+    def __repr__(self):
+        return (f"Replica({self.replica_id}, {self.state}, "
+                f"failures={self.consecutive_failures})")
+
+
+class _DeadEngine:
+    """Stand-in for a hard-killed replica process: every access fails
+    the way a connection to a dead host does, so the router's normal
+    detection path — failed step, missed probe — finds the corpse."""
+
+    def __init__(self, replica_id):
+        object.__setattr__(self, "_rid", replica_id)
+
+    def __getattr__(self, name):
+        raise OSError(f"replica {self._rid} process is dead "
+                      f"(attempted .{name})")
+
+
+class FleetRouter:
+    """Health-routed fan-out over N in-process serving engines.
+
+    ``replicas`` is a list whose items are either zero-arg callables
+    returning a fresh :class:`~paddle_tpu.serving.Engine` (the normal
+    form — restarts rebuild through the factory) or live ``Engine``
+    instances (restart unavailable).  Drive it like an engine:
+    :meth:`submit` then :meth:`step` in a loop, or :meth:`generate`.
+
+    Knobs: ``breaker_threshold`` failures open a replica's breaker
+    (default 1 — fail fast, re-dispatch is exactly-once and cheap);
+    ``probe_miss_threshold`` consecutive failed health probes count as
+    one failure path; ``stall_timeout_s`` bounds the *wall* time an
+    admission may take before the replica is declared wedged;
+    ``backoff_base_s``/``backoff_cap_s`` shape the jittered
+    backpressure window; ``drain_deadline_s`` is the default rolling-
+    restart drain budget; ``warmup`` (a callable taking an Engine) runs
+    on every factory-rebuilt engine before it re-enters rotation, so a
+    restarted replica doesn't serve its first request cold.
+    ``clock``/``tracer``/``registry`` mirror the engine's injection
+    points."""
+
+    def __init__(self, replicas, *, clock=None, tracer=None, registry=None,
+                 breaker_threshold=1, probe_miss_threshold=2,
+                 stall_timeout_s=0.25, backoff_base_s=0.05,
+                 backoff_cap_s=2.0, drain_deadline_s=5.0, warmup=None,
+                 rng=None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.warmup = warmup
+        self._clock = clock or time.perf_counter
+        if tracer is None:
+            tracer = (default_tracer() if clock is None
+                      else Tracer(clock=self._clock))
+        self.tracer = tracer
+        self.metrics = RouterMetrics(registry=registry)
+        self.breaker_threshold = int(breaker_threshold)
+        self.probe_miss_threshold = int(probe_miss_threshold)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self._rng = rng or random
+        self.replicas = []
+        for item in replicas:
+            rid = len(self.replicas)
+            # a callable (that isn't itself an engine) is a factory —
+            # restarts rebuild through it; anything else is taken as a
+            # live engine-shaped object (restart unavailable)
+            if callable(item) and not isinstance(item, Engine):
+                self.replicas.append(Replica(rid, item(), factory=item))
+            else:
+                self.replicas.append(Replica(rid, item, factory=None))
+            self.metrics.breaker_open.labels(replica=str(rid)).set(0)
+        self._pending = deque()
+        self._assigned = {rep.replica_id: {} for rep in self.replicas}
+        self._next_id = 0
+        self._update_gauges()
+
+    # ------------------------------------------------------------- lookup
+    def _rep(self, replica_id):
+        for rep in self.replicas:
+            if rep.replica_id == replica_id:
+                return rep
+        raise KeyError(f"no replica {replica_id!r}")
+
+    # ------------------------------------------------------------- submit
+    def submit(self, prompt, sampling: SamplingParams = None):
+        """Enqueue a prompt with the router; returns a
+        :class:`FleetRequest`.  Dispatch to a replica happens on the
+        next :meth:`step` (drain-based placement needs fresh health)."""
+        sampling = sampling or SamplingParams()
+        now = self._clock()
+        freq = FleetRequest(id=self._next_id, prompt=list(prompt),
+                            sampling=sampling, t_submit=now)
+        self._next_id += 1
+        if sampling.ttl_s is not None:
+            # the fleet-level deadline: survives failover (the remaining
+            # budget, not a fresh TTL, rides to the next replica)
+            freq.deadline = now + float(sampling.ttl_s)
+        freq._span = self.tracer.start_trace(
+            f"fleet#{freq.id}", start_s=now,
+            attributes={"request_id": freq.id,
+                        "prompt_len": len(freq.prompt),
+                        "max_new_tokens": sampling.max_new_tokens})
+        self._pending.append(freq)
+        self.metrics.pending_depth.set(len(self._pending))
+        return freq
+
+    # ----------------------------------------------------------- lifecycle
+    def _finish(self, freq, state, reason):
+        freq.state = state
+        freq.finish_reason = reason
+        freq.t_finished = self._clock()
+        if freq._span is not None:
+            freq._span.set_attributes({
+                "state": state, "finish_reason": reason,
+                "tokens_out": len(freq.tokens_out),
+                "dispatches": freq.dispatches,
+                "redispatches": freq.redispatches})
+            freq._span.end(freq.t_finished)
+            freq._span = None
+
+    def _harvest(self, rep, finished):
+        """Sync sampled tokens off ``rep`` after a successful step and
+        retire requests the engine finished.  Harvested tokens are the
+        failover ground truth — what the fleet has already emitted."""
+        table = self._assigned[rep.replica_id]
+        for freq in list(table.values()):
+            ereq = freq._engine_req
+            out = ereq.output
+            # engine preemption rewinds ereq.output and replays the
+            # identical tokens; never un-harvest on the rewind
+            if len(out) > len(freq.tokens_out) - freq._dispatch_base:
+                freq.tokens_out[freq._dispatch_base:] = list(out)
+                if freq.t_first_token is None and freq.tokens_out:
+                    freq.t_first_token = self._clock()
+                    self.metrics.ttft.observe(
+                        freq.t_first_token - freq.t_submit)
+            if ereq.state == RequestState.FINISHED:
+                del table[freq.id]
+                self._finish(freq, FleetRequestState.FINISHED,
+                             ereq.finish_reason)
+                finished.append(freq)
+            elif ereq.state == RequestState.EVICTED:
+                del table[freq.id]
+                self._finish(freq, FleetRequestState.EVICTED,
+                             ereq.finish_reason)
+                finished.append(freq)
+
+    # ------------------------------------------------------------ failure
+    def _reclaim(self, rep):
+        """Pull every request assigned to ``rep`` back into the router
+        queue (front, original order), each exactly once.  Only tokens
+        harvested after a completed step ride along — the re-dispatch
+        admission is ``prompt + tokens_out``, so the next replica
+        rebuilds KV state from scratch and cannot double-emit."""
+        table = self._assigned[rep.replica_id]
+        moved = list(table.values())
+        table.clear()
+        try:
+            # frees the abandoned engine's pages (and closes request
+            # traces) when it is still reachable; a hard-dead engine
+            # has nothing left to salvage
+            rep.engine.evacuate()
+        except Exception:
+            pass
+        for freq in reversed(moved):
+            freq.state = FleetRequestState.PENDING
+            freq.replica_id = None
+            freq._engine_req = None
+            freq.redispatches += 1
+            self._pending.appendleft(freq)
+            self.metrics.redispatched.inc()
+        self.metrics.pending_depth.set(len(self._pending))
+        return moved
+
+    def _on_replica_failure(self, rep, reason, exc=None):
+        """Count a failure against ``rep``; at ``breaker_threshold``
+        open the breaker and fail everything over."""
+        if rep.state == ReplicaState.DEAD:
+            return
+        rep.consecutive_failures += 1
+        if rep.consecutive_failures < self.breaker_threshold:
+            return
+        if rep._drain_span is not None:      # failed mid-drain
+            rep._drain_span.set_attributes({"failed": reason})
+            rep._drain_span.end()
+            rep._drain_span = None
+        rep.state = ReplicaState.DEAD
+        rep.drain_deadline = None
+        rid = str(rep.replica_id)
+        self.metrics.breaker_open.labels(replica=rid).set(1)
+        self.metrics.failovers.labels(replica=rid, reason=reason).inc()
+        span = self.tracer.start_trace(
+            "router::failover",
+            attributes={"replica": rep.replica_id, "reason": reason,
+                        "error": repr(exc) if exc is not None else None})
+        moved = self._reclaim(rep)
+        span.set_attribute("redispatched", len(moved))
+        span.end()
+        self._update_gauges()
+
+    # -------------------------------------------------------------- admit
+    def _can_admit(self, rep, now):
+        return rep.state == ReplicaState.HEALTHY and now >= rep.not_before
+
+    def _backpressure(self, rep, hint_s, now):
+        """RETRY_AFTER from ``rep``: close its admission window for
+        max(drain hint, jittered exponential delay), capped — bounded
+        backoff that neither hammers nor abandons a loaded replica."""
+        if rep.backoff is None:
+            rep.backoff = backoff_delays(base=self.backoff_base_s,
+                                         cap=self.backoff_cap_s,
+                                         rng=self._rng)
+        delay = min(self.backoff_cap_s,
+                    max(float(hint_s or 0.0), next(rep.backoff)))
+        rep.not_before = now + delay
+        self.metrics.backpressure_retries.labels(
+            replica=str(rep.replica_id)).inc()
+        return delay
+
+    def _dispatch(self, freq, rep, now):
+        """Try the queue-head request on ``rep``.  Returns one of
+        "dispatched" / "backpressure" / "rejected" / "evicted" /
+        "failed" (replica, not request, at fault)."""
+        already = len(freq.tokens_out)
+        kw = {"max_new_tokens": freq.sampling.max_new_tokens - already}
+        if freq.deadline is not None:
+            remaining = freq.deadline - now
+            if remaining <= 0:
+                self._pending.popleft()
+                self._finish(freq, FleetRequestState.EVICTED, "deadline")
+                return "evicted"
+            kw["ttl_s"] = remaining
+        esp = dataclasses.replace(freq.sampling, **kw)
+        t0 = _wall()
+        try:
+            ereq = rep.engine.add_request(freq.prompt + freq.tokens_out,
+                                          esp)
+        except OSError as e:
+            self._on_replica_failure(rep, "io_error", e)
+            return "failed"
+        stalled = (_wall() - t0) > self.stall_timeout_s
+        if ereq.state == RequestState.RETRY_AFTER:
+            self._backpressure(rep, ereq.retry_after_s, now)
+            if stalled:
+                self._on_replica_failure(rep, "stall")
+            return "backpressure"
+        if ereq.state == RequestState.REJECTED:
+            self._pending.popleft()
+            self._finish(freq, FleetRequestState.REJECTED,
+                         ereq.finish_reason)
+            return "rejected"
+        # QUEUED: the replica's scheduler owns it now
+        self._pending.popleft()
+        freq.state = FleetRequestState.DISPATCHED
+        freq.replica_id = rep.replica_id
+        freq._engine_req = ereq
+        freq._dispatch_base = already
+        freq.dispatches += 1
+        self._assigned[rep.replica_id][freq.id] = freq
+        rep.backoff = None                   # successful admission resets
+        self.metrics.dispatches.labels(replica=str(rep.replica_id)).inc()
+        self.tracer.start_trace(
+            "router::dispatch", start_s=now,
+            attributes={"request_id": freq.id,
+                        "replica": rep.replica_id,
+                        "redispatch": freq.redispatches > 0}).end(now)
+        if stalled:
+            # admission wedge (serving.admit stall site): the request IS
+            # assigned, so the failure path reclaims it exactly once
+            self._on_replica_failure(rep, "stall")
+        return "dispatched"
+
+    def _admit(self, now):
+        """Place queued requests on the lowest-drain admittable replica;
+        a backpressuring or failing replica is skipped for the rest of
+        this tick."""
+        skip = set()
+        while self._pending:
+            cands = []
+            for rep in self.replicas:
+                if rep.replica_id in skip or not self._can_admit(rep, now):
+                    continue
+                try:
+                    h = rep.engine.health()
+                except OSError as e:
+                    self._on_replica_failure(rep, "probe", e)
+                    continue
+                cands.append((float(h.get("estimated_drain_s") or 0.0),
+                              (h.get("queue_depth") or 0)
+                              + (h.get("running") or 0),
+                              rep.replica_id, rep))
+            if not cands:
+                break
+            cands.sort(key=lambda c: c[:3])
+            rep = cands[0][3]
+            status = self._dispatch(self._pending[0], rep, now)
+            if status in ("backpressure", "failed"):
+                skip.add(rep.replica_id)
+        self.metrics.pending_depth.set(len(self._pending))
+
+    # --------------------------------------------------------------- drain
+    def drain(self, replica_id, deadline_s=None, restart=True):
+        """Graceful rolling-restart entry: stop admitting to the
+        replica, let in-flight decode finish within the deadline
+        (stragglers re-dispatched), then rebuild its engine from the
+        factory and re-enter rotation (``restart=False`` leaves it out
+        of rotation instead)."""
+        rep = self._rep(replica_id)
+        if rep.state != ReplicaState.HEALTHY:
+            raise ValueError(f"replica {replica_id} is {rep.state}; only "
+                             f"a healthy replica can start draining")
+        if restart and rep.factory is None:
+            raise ValueError(f"replica {replica_id} has no factory; "
+                             f"drain(restart=False) or rebuild manually")
+        rep.state = ReplicaState.DRAINING
+        rep.drain_deadline = self._clock() + (
+            self.drain_deadline_s if deadline_s is None else
+            float(deadline_s))
+        rep.restart_after_drain = restart
+        rep._drain_span = self.tracer.start_trace(
+            "router::drain",
+            attributes={"replica": replica_id,
+                        "deadline_s": rep.drain_deadline,
+                        "in_flight": len(self._assigned[replica_id])})
+        self.metrics.drains.labels(replica=str(replica_id)).inc()
+        self._update_gauges()
+        return rep
+
+    def _finish_drain(self, rep, now):
+        stragglers = self._reclaim(rep)
+        if rep._drain_span is not None:
+            rep._drain_span.set_attributes(
+                {"stragglers": len(stragglers),
+                 "deadline_hit": bool(stragglers)})
+            rep._drain_span.end(now)
+            rep._drain_span = None
+        rep.drain_deadline = None
+        if rep.restart_after_drain:
+            self._restart(rep)
+        else:
+            rep.state = ReplicaState.DEAD
+            self.metrics.breaker_open.labels(
+                replica=str(rep.replica_id)).set(1)
+
+    # ------------------------------------------------------------- restart
+    def _restart(self, rep):
+        eng = rep.factory()
+        if self.warmup is not None:
+            # e.g. a tiny generate() that compiles the unified step:
+            # a replica re-enters rotation warm, so the first real
+            # request routed to it doesn't pay the compile
+            self.warmup(eng)
+        rep.engine = eng
+        rep.state = ReplicaState.HEALTHY
+        rep.consecutive_failures = 0
+        rep.probe_misses = 0
+        rep.not_before = 0.0
+        rep.backoff = None
+        rep.drain_deadline = None
+        self.metrics.breaker_open.labels(replica=str(rep.replica_id)).set(0)
+        self.metrics.restarts.labels(replica=str(rep.replica_id)).inc()
+        self._update_gauges()
+
+    def restart_replica(self, replica_id):
+        """Rebuild a dead/drained replica's engine from its factory and
+        close the breaker — the fleet supervisor's revive hook."""
+        rep = self._rep(replica_id)
+        if rep.factory is None:
+            raise ValueError(f"replica {replica_id} was built from a "
+                             f"live Engine, not a factory — cannot "
+                             f"restart")
+        self._restart(rep)
+        return rep
+
+    def kill_replica(self, replica_id):
+        """Emulate a hard replica death (process SIGKILL): the engine
+        is replaced by a stub whose every access raises ``OSError``, so
+        the normal detection path — failed step, missed probe — finds
+        the corpse on the next tick.  Test/bench/ops hook."""
+        rep = self._rep(replica_id)
+        rep.engine = _DeadEngine(replica_id)
+        return rep
+
+    # ---------------------------------------------------------------- step
+    def step(self):
+        """One fleet tick: advance every live replica one scheduler
+        step (harvesting outputs and detecting failures), progress
+        drains, probe health, then place queued requests.  Returns the
+        fleet requests that reached a terminal state this tick."""
+        now = self._clock()
+        finished = []
+        for rep in self.replicas:
+            if rep.state == ReplicaState.DEAD:
+                continue
+            try:
+                has_work = rep.engine.has_work()
+            except OSError as e:
+                self._on_replica_failure(rep, "crash", e)
+                continue
+            if not has_work or (rep.state == ReplicaState.DRAINING
+                                and now >= rep.drain_deadline):
+                continue          # deadline-hit drains reclaim below
+            try:
+                rep.engine.step()
+            except OSError as e:
+                self._on_replica_failure(rep, "io_error", e)
+                continue
+            rep.consecutive_failures = 0
+            self._harvest(rep, finished)
+        # drain completion runs after the step pass so the tick that
+        # harvests a draining replica's last request also restarts it —
+        # callers looping on has_work() never strand a drain
+        for rep in self.replicas:
+            if rep.state != ReplicaState.DRAINING:
+                continue
+            try:
+                drained = not rep.engine.has_work()
+            except OSError as e:
+                self._on_replica_failure(rep, "crash", e)
+                continue
+            if drained or now >= rep.drain_deadline:
+                self._finish_drain(rep, now)
+        # health probes: a wedged-but-idle replica never fails a step,
+        # so the probe path is what retires it
+        for rep in self.replicas:
+            if rep.state == ReplicaState.DEAD:
+                continue
+            try:
+                rep.engine.health()
+                rep.probe_misses = 0
+            except OSError as e:
+                rep.probe_misses += 1
+                if rep.probe_misses >= self.probe_miss_threshold:
+                    self._on_replica_failure(rep, "probe", e)
+        self._admit(now)
+        self._update_gauges()
+        return finished
+
+    def has_work(self):
+        return bool(self._pending) or any(self._assigned[rep.replica_id]
+                                          for rep in self.replicas)
+
+    def generate(self, prompts, sampling=None):
+        """Batch convenience mirroring ``Engine.generate``: submit all,
+        step the fleet until every request is terminal (or no replica
+        is left alive), return each request's output tokens."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling] * len(prompts)
+        reqs = [self.submit(p, s) for p, s in zip(prompts, sampling)]
+        while self.has_work():
+            if all(rep.state == ReplicaState.DEAD
+                   for rep in self.replicas):
+                break                     # nobody left to run on
+            self.step()
+        return [r.output for r in reqs]
+
+    # -------------------------------------------------------------- health
+    def _update_gauges(self):
+        admittable = sum(1 for rep in self.replicas
+                         if rep.state == ReplicaState.HEALTHY)
+        self.metrics.replicas_admittable.set(admittable)
+        self.metrics.fleet_healthy.set(1 if admittable else 0)
+
+    def fleet_health(self):
+        """The ``/healthz`` fleet fold: healthy iff at least one
+        replica can admit new work.  A single shedding replica is a
+        soft signal (its own RETRY_AFTER says so) — only a fleet where
+        every breaker is open or every replica is draining is down."""
+        per = {}
+        for rep in self.replicas:
+            per[str(rep.replica_id)] = {
+                "state": rep.state,
+                "breaker_open": rep.state == ReplicaState.DEAD,
+                "in_flight": len(self._assigned[rep.replica_id]),
+            }
+        admittable = sum(1 for rep in self.replicas
+                         if rep.state == ReplicaState.HEALTHY)
+        return {"healthy": admittable > 0,
+                "replicas_admittable": admittable,
+                "replicas_total": len(self.replicas),
+                "pending": len(self._pending),
+                "replicas": per}
+
+    def fleet_status(self):
+        """The ``/fleet`` endpoint payload: per-replica state + live
+        engine health (guarded — a dead replica reports its error
+        instead of wedging the scrape) and the router counters."""
+        now = self._clock()
+        per = {}
+        for rep in self.replicas:
+            entry = {
+                "state": rep.state,
+                "breaker_open": rep.state == ReplicaState.DEAD,
+                "consecutive_failures": rep.consecutive_failures,
+                "probe_misses": rep.probe_misses,
+                "backpressure_for_s": max(0.0, rep.not_before - now),
+                "in_flight": len(self._assigned[rep.replica_id]),
+                "restartable": rep.factory is not None,
+            }
+            if rep.drain_deadline is not None:
+                entry["drain_deadline_in_s"] = rep.drain_deadline - now
+            try:
+                entry["engine"] = rep.engine.health()
+            except OSError as e:
+                entry["engine"] = {"error": repr(e)}
+            per[str(rep.replica_id)] = entry
+        out = self.fleet_health()
+        out["replicas"] = per
+        out["counters"] = self.metrics.snapshot()
+        return out

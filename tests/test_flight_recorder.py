@@ -222,18 +222,23 @@ class TestRetryAfterHint:
         assert shed.retry_after_s == pytest.approx(expected, rel=1e-6)
         assert "retry in" in shed.finish_reason
 
-    def test_drain_estimate_zero_when_idle_and_fallback_before_decode(self):
+    def test_drain_estimate_floored_before_decode_sample(self):
         eng = _tiny_engine(clock=ManualClock(auto=0.001),
                            shed_queue_high=1)
-        assert eng.estimated_drain_s() == 0.0
+        # cold start: no EWMA sample yet — the conservative floor, not
+        # a hammer-inviting 0 (the fleet router would otherwise dump
+        # the whole backlog on a freshly restarted replica)
+        assert eng.estimated_drain_s() == eng.drain_floor_s > 0
         assert eng.decode_rate() is None
         eng.add_request([1, 2], SamplingParams(max_new_tokens=8))
-        # no decode yet → ASSUMED_DECODE_RATE keeps the estimate finite
+        # small backlog, still cold → the floor dominates the
+        # ASSUMED_DECODE_RATE fallback (0.08s here)
         est = eng.estimated_drain_s()
-        assert est == pytest.approx(8 / Engine.ASSUMED_DECODE_RATE)
+        assert est == max(8 / Engine.ASSUMED_DECODE_RATE,
+                          eng.drain_floor_s)
         shed = eng.add_request([3], SamplingParams(max_new_tokens=8))
         assert shed.state == RequestState.RETRY_AFTER
-        assert shed.retry_after_s > 0
+        assert shed.retry_after_s >= eng.drain_floor_s
 
     def test_health_and_gauges_publish_drain(self):
         clk = ManualClock(auto=0.001)

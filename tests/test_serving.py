@@ -726,6 +726,140 @@ class TestWatermarkShedding:
         assert eng.metrics.engine_healthy.value == 1
 
 
+class _AutoClock:
+    """Manual clock that also self-advances per read — gives steps a
+    deterministic nonzero duration so the decode-rate EWMA gets a real
+    (and exactly reproducible) sample."""
+
+    def __init__(self, auto=0.25):
+        self.t = 0.0
+        self.auto = auto
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        self.t += self.auto
+        return self.t
+
+
+class TestColdStartDrainFloor:
+    """Regression (this PR): before the decode-rate EWMA has any
+    sample, estimated_drain_s/retry_after_s used to report a useless 0
+    — a freshly restarted replica looked instantly drainable and the
+    router would dump the fleet's whole backlog on it.  The engine now
+    reports a conservative configurable floor until the first measured
+    decode step."""
+
+    def test_floor_applies_until_first_decode_sample(self, tiny_model):
+        cfg, params = tiny_model
+        clk = _AutoClock(auto=0.25)
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=32, clock=clk,
+                     drain_floor_s=3.0, shed_queue_high=1,
+                     shed_queue_low=0)
+        assert eng.decode_rate() is None
+        # idle + cold: the floor, not 0
+        assert eng.estimated_drain_s() == 3.0
+        first = eng.add_request(list(range(6)),
+                                SamplingParams(max_new_tokens=4))
+        shed = eng.add_request(list(range(4)),
+                               SamplingParams(max_new_tokens=4))
+        assert shed.state == RequestState.RETRY_AFTER
+        assert shed.retry_after_s >= 3.0      # the hint honors the floor
+        while eng.has_work():
+            eng.step()
+        assert first.state == RequestState.FINISHED
+        # a measured rate owns the estimate now: idle really means 0
+        assert eng.decode_rate() is not None and eng.decode_rate() > 0
+        assert eng.estimated_drain_s() == 0.0
+
+    def test_floor_defaults_on_and_is_configurable(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=32)
+        assert eng.drain_floor_s == Engine.DRAIN_FLOOR_S > 0
+        assert eng.estimated_drain_s() == Engine.DRAIN_FLOOR_S
+        off = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=32, drain_floor_s=0.0)
+        assert off.estimated_drain_s() == 0.0
+
+    def test_backlog_above_floor_still_wins(self, tiny_model):
+        """The floor is a floor, not a cap: a cold engine with a big
+        backlog reports the larger assumed-rate estimate."""
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=32, drain_floor_s=0.1)
+        eng.add_request(list(range(4)),
+                        SamplingParams(max_new_tokens=100))
+        expected = 100 / Engine.ASSUMED_DECODE_RATE      # 1.0 > 0.1
+        assert eng.estimated_drain_s() == pytest.approx(expected)
+
+
+# ----------------------------------------------------------- evacuation
+
+
+class TestEvacuate:
+    """Engine.evacuate() — the fleet router's failover/drain primitive:
+    everything in flight comes off the engine with sampled tokens
+    intact, pages freed, and a re-admission elsewhere continues
+    token-identically."""
+
+    def test_evacuate_returns_all_and_frees_pool(self, tiny_model):
+        cfg, params = tiny_model
+        rng = np.random.RandomState(29)
+        p1 = list(rng.randint(0, cfg.vocab_size, 6))
+        p2 = list(rng.randint(0, cfg.vocab_size, 20))   # mid-prefill
+        p3 = list(rng.randint(0, cfg.vocab_size, 5))    # still queued
+        # a dedicated tracer: the process-wide default ring holds other
+        # tests' traces, whose root spans carry no "state" attribute
+        from paddle_tpu.observability.tracing import Tracer
+
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=8, tracer=Tracer())
+        sp = SamplingParams(max_new_tokens=8)
+        r1, r2, r3 = (eng.add_request(p, sp) for p in (p1, p2, p3))
+        for _ in range(2):
+            eng.step()
+        assert r1.output                     # decoding
+        assert 0 < r2.prompt_pos             # chunking
+        assert r3.state == RequestState.QUEUED
+        got = eng.evacuate()
+        assert [r.id for r in got] == [r1.id, r2.id, r3.id]
+        assert all(r.state == RequestState.EVACUATED for r in got)
+        assert all(r.finish_reason == "evacuated" for r in got)
+        assert eng.cache.num_free_pages == eng.cache.num_pages
+        assert not eng.has_work()
+        # traces closed in the terminal state
+        states = {t["name"]: t["spans"][0]["attributes"]["state"]
+                  for t in eng.tracer.traces()}
+        assert states[f"request#{r1.id}"] == RequestState.EVACUATED
+
+    def test_reenqueue_elsewhere_is_token_identical(self, tiny_model):
+        """The idempotent re-enqueue contract: prompt + harvested
+        tokens resubmitted to a fresh engine (KV rebuilt, never
+        trusted) completes exactly the un-failed greedy output."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(31)
+        prompt = list(rng.randint(0, cfg.vocab_size, 9))
+        full = naive_generate(cfg, params, prompt, 10)
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=1, chunk_len=8)
+        req = eng.add_request(prompt, SamplingParams(max_new_tokens=10))
+        for _ in range(5):
+            eng.step()
+        (got,) = eng.evacuate()
+        emitted = got.output
+        assert 0 < len(emitted) < 10
+        other = Engine(cfg, params, page_size=8, num_pages=64,
+                       max_batch_size=1, chunk_len=8)
+        rest = other.generate(
+            [prompt + emitted],
+            SamplingParams(max_new_tokens=10 - len(emitted)))[0]
+        assert emitted + rest == full
+        assert req is got
+
+
 # --------------------------------------------------- satellite regressions
 
 
