@@ -126,8 +126,8 @@ class _LinkBuilder:
                 else:
                     self._r_default(eqn)
             except Exception:
-                # a malformed/unexpected eqn shape only costs inference
-                # power (replication), never correctness
+                # silent-ok: a malformed/unexpected eqn shape only costs
+                # inference power (replication), never correctness
                 continue
 
     def _r_default(self, eqn):

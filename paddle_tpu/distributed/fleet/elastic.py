@@ -81,9 +81,9 @@ class ElasticManager:
                 try:
                     self.store.add(self._key(), 1)
                 except Exception:
-                    # transient store error: keep beating — a single
-                    # blip must not silence a healthy node for good (the
-                    # peer-side timeout handles truly-dead stores)
+                    # silent-ok: transient store error — keep beating, a
+                    # single blip must not silence a healthy node for
+                    # good (peer-side timeout handles truly-dead stores)
                     continue
 
         self._thread = threading.Thread(target=beat, daemon=True)

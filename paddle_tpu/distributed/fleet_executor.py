@@ -82,7 +82,7 @@ class MessageBus:
         try:
             self.store.delete_key(key)
         except Exception:
-            pass
+            pass    # silent-ok: best-effort cleanup of a consumed key
         return jax.tree_util.tree_unflatten(blob["treedef"], leaves)
 
 

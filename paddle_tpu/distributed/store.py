@@ -279,7 +279,7 @@ class TCPStore:
                 self._client = None
             self._close_server()
         except Exception:
-            pass
+            pass    # silent-ok: interpreter-shutdown destructor
 
     def _close_server(self):
         if getattr(self, "_server", None):

@@ -5,6 +5,10 @@ from .callbacks import (Callback, CheckpointCallback, EarlyStopping,
                         ProgBarLogger)
 from .model import Model
 
+# imported AFTER callbacks/model so the resilience package (which sits
+# below hapi) can finish loading without a cycle
+from ..resilience.integrity import IntegrityCallback  # noqa: E402
+
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
            "CheckpointCallback", "EarlyStopping", "LRScheduler",
-           "ProfilerCallback", "callbacks"]
+           "ProfilerCallback", "IntegrityCallback", "callbacks"]

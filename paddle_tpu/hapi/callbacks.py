@@ -334,7 +334,7 @@ class CheckpointCallback(Callback):
     """
 
     def __init__(self, save_dir=None, every_n_steps=10, keep_last_n=3,
-                 async_save=False, manager=None):
+                 async_save=False, manager=None, verify_on_save=False):
         super().__init__()
         if manager is None:
             from ..resilience import CheckpointManager
@@ -343,21 +343,25 @@ class CheckpointCallback(Callback):
                 raise ValueError("CheckpointCallback needs save_dir "
                                  "or manager")
             manager = CheckpointManager(save_dir, keep_last_n=keep_last_n,
-                                        async_save=async_save)
+                                        async_save=async_save,
+                                        verify_on_save=verify_on_save)
         self.manager = manager
         self.every_n_steps = int(every_n_steps)
         self._epoch = 0
         self._global_step = 0
         self._skipped_windows = []
+        self._repairs = []
 
     def on_train_begin(self, logs=None):
         info = getattr(self.model, "_resume_info", None) or {}
         self._global_step = int(info.get("global_step", 0))
-        # skipped windows survive resume: they ride in every later
-        # manifest so an operator can always see what data a rollback
-        # dropped, however many relaunches later
+        # skipped windows and integrity repairs survive resume: they
+        # ride in every later manifest so an operator can always see
+        # what data a rollback dropped (or what corruption was
+        # repaired), however many relaunches later
         self._skipped_windows = [dict(w) for w
                                  in info.get("skipped_windows", [])]
+        self._repairs = [dict(r) for r in info.get("repairs", [])]
 
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
@@ -369,6 +373,18 @@ class CheckpointCallback(Callback):
 
     def on_train_end(self, logs=None):
         self.manager.wait()        # surface a failed async save here
+
+    def rewind_to(self, global_step):
+        """Integrity rewind-and-replay repair: step counting follows
+        the restored checkpoint — replayed steps re-save over the
+        discarded poisoned ones at the same step numbers."""
+        self._global_step = int(global_step)
+
+    def record_repair(self, repair):
+        """Remember an integrity repair (no data skipped — the rewind
+        replays it); rides in every later manifest like a skipped
+        window does."""
+        self._repairs.append(dict(repair))
 
     def record_rollback(self, window, next_step):
         """Make a health rollback durable: remember the skipped data
@@ -394,6 +410,8 @@ class CheckpointCallback(Callback):
         if self._skipped_windows:
             extra["skipped_windows"] = [dict(w) for w
                                         in self._skipped_windows]
+        if self._repairs:
+            extra["repairs"] = [dict(r) for r in self._repairs]
         sched = _lr_scheduler_of(self.model)
         if sched is not None:
             extra["lr_scheduler"] = sched.state_dict()

@@ -23,7 +23,7 @@ from ..io.dataloader import DataLoader
 from ..observability.compile_watchdog import watch
 from ..profiler.profiler import RecordEvent
 from ..resilience.atomic import atomic_write
-from ..resilience.faults import fault_point
+from ..resilience.faults import current_injector, fault_point
 from .callbacks import CallbackList, ProgBarLogger
 
 __all__ = ["Model"]
@@ -57,6 +57,8 @@ class Model:
         self._watch_grad_norm = False   # train_batch reports grad_norm
         self._jit_step_gnorm = False    # arity the built step returns
         self._rollback_request = None   # set by HealthMonitor(rollback)
+        self._stash_batch = False       # IntegrityCallback replay feed
+        self._last_batch = None
 
     def enable_grad_norm_logging(self):
         """Make ``train_batch`` report the global gradient norm in its
@@ -200,10 +202,66 @@ class Model:
             opt.clear_grad()
             loss = loss_t.data
             out = out_t.data
+        if current_injector() is not None:
+            self._expose_params_fault_site()
         results = self._update_metrics(out, y)
         if gnorm is not None:
             results["grad_norm"] = float(gnorm)
         return float(loss), results
+
+    def _expose_params_fault_site(self):
+        """The silent-data-corruption injection point: with a fault
+        injector installed, the post-step parameters pass through the
+        ``hapi.step_params`` site as a mutable ``{name: array}`` dict —
+        a ``bitflip`` spec replaces one leaf with a one-bit-corrupted
+        copy, exactly the failure the integrity sentinel exists to
+        catch.  Zero cost without an injector (guarded at the call
+        site)."""
+        named = dict(self.network.named_parameters())
+        tree = {k: p.data for k, p in named.items()}
+        before = dict(tree)
+        fault_point("hapi.step_params", tree=tree)
+        for k, v in tree.items():
+            if v is not before[k]:
+                named[k].data = jnp.asarray(v)
+
+    def replay_train_batch(self, snapshot, batch):
+        """Pure re-execution of one train step from a pre-step
+        ``snapshot`` (``params``/``buffers``/``opt_state``/``rng``/
+        ``lr`` — the integrity sentinel captures it at batch begin).
+        Mutates NOTHING on the model: the jitted step is a pure
+        function, the stateful RNG streams are restored afterwards.
+        Returns ``(loss, new_params)`` for bitwise comparison against
+        the live step's outcome.  Only the jitted functional-optimizer
+        path replays; the eager fallback has no pure step to re-run."""
+        from ..core.random import get_rng_state, set_rng_state
+
+        opt = self._optimizer
+        if not hasattr(opt, "apply_gradients"):
+            raise RuntimeError("step replay requires the jitted "
+                               "functional optimizer path")
+        inputs, labels = batch
+        x = _as_array(_to_list(inputs)[0])
+        y = _as_array(_to_list(labels)[0])
+        x, y = self._shard_batch(x, y)
+        params = snapshot["params"]
+        opt_state = snapshot.get("opt_state")
+        if opt_state is None:
+            opt_state = opt.init_state(params)
+        step = self._build_jit_step()
+        lr = jnp.asarray(snapshot.get("lr", opt.get_lr()), jnp.float32)
+        saved_rng = dict(get_rng_state())
+        try:
+            if snapshot.get("rng"):
+                set_rng_state(snapshot["rng"])
+            outs = step(params, snapshot["buffers"], opt_state, x, y, lr)
+        finally:
+            set_rng_state(saved_rng)
+        if self._jit_step_gnorm:
+            new_params, _, loss, _, _, _ = outs
+        else:
+            new_params, _, loss, _, _ = outs
+        return float(loss), dict(new_params)
 
     def eval_batch(self, inputs, labels):
         x = _as_array(_to_list(inputs)[0])
@@ -328,31 +386,48 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
-                if epoch == resume_epoch and step < resume_step:
-                    continue           # already trained before the crash
-                cblist.on_train_batch_begin(step)
-                flight.note_step(step, epoch=epoch)
-                x, y = batch[0], batch[1]
-                with tracer.trace("hapi::step",
-                                  {"epoch": epoch, "step": step}) as sp:
-                    loss, res = self.train_batch(x, y)
-                    sp.set_attribute("loss", float(loss))
-                logs = {"loss": loss, **res}
-                cblist.on_train_batch_end(step, logs)
-                if self._rollback_request is not None:
-                    # a HealthMonitor(action="rollback") flagged this
-                    # step: restore the last-good checkpoint and let the
-                    # loop continue with the NEXT batch — the offending
-                    # data window is skipped, durably
-                    req, self._rollback_request = \
-                        self._rollback_request, None
-                    self._execute_rollback(req, cblist, epoch, step)
-                # simulated-preemption site: crash-consistency tests kill
-                # fit here, AFTER the checkpoint callback ran for this step
-                fault_point("hapi.train_step")
-                if self.stop_training:
+            start_step = resume_step if epoch == resume_epoch else 0
+            while True:     # re-entered only on an integrity rewind
+                rewound = False
+                for step, batch in enumerate(train_loader):
+                    if step < start_step:
+                        continue   # trained before the crash / rewind
+                    cblist.on_train_batch_begin(step)
+                    flight.note_step(step, epoch=epoch)
+                    x, y = batch[0], batch[1]
+                    if self._stash_batch:
+                        self._last_batch = (x, y)
+                    with tracer.trace("hapi::step",
+                                      {"epoch": epoch,
+                                       "step": step}) as sp:
+                        loss, res = self.train_batch(x, y)
+                        sp.set_attribute("loss", float(loss))
+                    logs = {"loss": loss, **res}
+                    cblist.on_train_batch_end(step, logs)
+                    if self._rollback_request is not None:
+                        # a rollback-action anomaly flagged this step:
+                        # restore the last-good checkpoint and either
+                        # skip the offending data window (poisoned
+                        # batch) or rewind and REPLAY it (corrupted
+                        # state, healthy data — integrity repair)
+                        req, self._rollback_request = \
+                            self._rollback_request, None
+                        rewind_to = self._execute_rollback(
+                            req, cblist, epoch, step)
+                        if rewind_to is not None:
+                            start_step = int(rewind_to)
+                            rewound = True
+                            break
+                    # simulated-preemption site: crash-consistency tests
+                    # kill fit here, AFTER the checkpoint callback ran
+                    # for this step
+                    fault_point("hapi.train_step")
+                    if self.stop_training:
+                        break
+                if not rewound:
                     break
+                # replaying requires the loader to reproduce its order;
+                # shuffle=False (or a seeded sampler) is on the operator
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, callbacks=[],
                                           verbose=0)
@@ -366,16 +441,24 @@ class Model:
 
     def _execute_rollback(self, req, cblist, epoch, step):
         """Health-triggered rollback: restore the newest intact
-        checkpoint *older than the anomalous step* and record the
-        skipped data window.
+        checkpoint *older than the anomalous step*.
 
-        The loop position does not move — training simply continues
-        with the next batch on last-good params, so batches between the
-        restored checkpoint and the anomaly (the poisoned batch plus up
-        to ``every_n_steps - 1`` good ones, the documented skipped-
-        window granularity) are never replayed.  The window is
-        committed to the checkpoint manifest immediately, so a crash
-        right after the rollback resumes past it too."""
+        Two modes.  Default (poisoned batch): the loop position does
+        not move — training simply continues with the next batch on
+        last-good params, so batches between the restored checkpoint
+        and the anomaly (the poisoned batch plus up to
+        ``every_n_steps - 1`` good ones, the documented skipped-window
+        granularity) are never replayed.  The window is committed to
+        the checkpoint manifest immediately, so a crash right after
+        the rollback resumes past it too.
+
+        ``req["rewind"]`` (integrity repair — corrupted *state*,
+        healthy data): restore the newest checkpoint older than
+        ``req["restore_before"]`` (the last cross-rank-verified step),
+        discard the newer, numerically-poisoned saves, rewind every
+        step-counting callback, and return the loop step to REPLAY
+        from — the same batches retrain on verified-good state,
+        reconverging bitwise with the healthy replicas."""
         from ..observability.health import TrainingHealthError
         from .callbacks import CheckpointCallback, restore_fit_state
 
@@ -389,13 +472,16 @@ class Model:
                         f"nothing to roll back to")
         cb.manager.wait()          # join an in-flight poisoned save
         bad_global_step = cb._global_step
-        info = restore_fit_state(self, cb.manager,
-                                 before_step=bad_global_step)
+        before = int(req.get("restore_before", bad_global_step))
+        info = restore_fit_state(self, cb.manager, before_step=before)
         if info is None:
             raise TrainingHealthError(
                 reason, f"rollback requested at step {step} but no "
                         f"intact checkpoint precedes global step "
-                        f"{bad_global_step}")
+                        f"{before}")
+        if req.get("rewind"):
+            return self._finish_rewind_rollback(req, cblist, cb, info,
+                                                epoch, step)
         window = {
             "reason": reason,
             "epoch": int(epoch),
@@ -408,6 +494,40 @@ class Model:
             "restored_global_step": int(info.get("global_step", 0)),
         }
         cb.record_rollback(window, next_step=step + 1)
+        self._note_rollback(window, reason, epoch, step)
+
+    def _finish_rewind_rollback(self, req, cblist, cb, info, epoch,
+                                step):
+        """The integrity-repair tail of a rollback: poisoned newer
+        saves are discarded (they verify CRC-clean but hold corrupt
+        numbers — until the replay overwrites them they would be the
+        newest restore candidates for any crash), step counters rewind,
+        and the returned loop step tells ``fit`` where to resume
+        replaying."""
+        reason = req.get("reason", "param_divergence")
+        restored_gs = int(info.get("global_step", 0))
+        rewind_step = int(info.get("next_step", 0))
+        cb.manager.discard_after(restored_gs)
+        for c in cblist.callbacks:
+            rewind = getattr(c, "rewind_to", None)
+            if callable(rewind):
+                rewind(restored_gs)
+        repair = {
+            "reason": reason,
+            "epoch": int(epoch),
+            "detected_step": int(step),
+            "replay_from_step": rewind_step,
+            "global_step": int(req.get("step", step)),
+            "restored_global_step": restored_gs,
+            "rewind": True,
+        }
+        if hasattr(cb, "record_repair"):
+            cb.record_repair(repair)
+        self._note_rollback(repair, reason, epoch, step)
+        return rewind_step
+
+    @staticmethod
+    def _note_rollback(window, reason, epoch, step):
         from ..observability.metrics import default_registry
         from ..observability.tracing import default_tracer
 
@@ -420,11 +540,18 @@ class Model:
         span.end()
         import logging
 
-        logging.getLogger("paddle_tpu.hapi").warning(
-            "rolled back to checkpoint step %s after %s at epoch %d "
-            "step %d; skipping data window [%d, %d]",
-            window["restored_global_step"], reason, epoch, step,
-            window["first_step"], window["last_step"])
+        if window.get("rewind"):
+            logging.getLogger("paddle_tpu.hapi").warning(
+                "rolled back to checkpoint step %s after %s at epoch "
+                "%d step %d; replaying from step %d (no data skipped)",
+                window["restored_global_step"], reason, epoch, step,
+                window["replay_from_step"])
+        else:
+            logging.getLogger("paddle_tpu.hapi").warning(
+                "rolled back to checkpoint step %s after %s at epoch "
+                "%d step %d; skipping data window [%d, %d]",
+                window["restored_global_step"], reason, epoch, step,
+                window["first_step"], window["last_step"])
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
                  callbacks=None, **kw):

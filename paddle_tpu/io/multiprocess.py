@@ -81,7 +81,7 @@ def _pack_shm(arrays):
 
         resource_tracker.unregister(shm._name, "shared_memory")
     except Exception:
-        pass
+        pass    # silent-ok: tracker may not know this segment (cleanup)
     return name, metas
 
 
@@ -266,7 +266,8 @@ class MultiprocessIter:
                 try:
                     self.index_q.put_nowait(None)
                 except Exception:
-                    break
+                    break   # silent-ok: full/closed queue — workers are
+                            # woken by queue close during shutdown anyway
         deadline = _time.monotonic() + 10.0
         while (any(p.is_alive() for p in self._procs)
                and _time.monotonic() < deadline):
@@ -287,27 +288,47 @@ class MultiprocessIter:
             pass
 
     def _get(self):
-        """Pop a result; poll worker liveness so a SIGKILLed/segfaulted
-        worker (which can't enqueue an error) raises instead of hanging
-        the training loop forever."""
+        """Pop a result; poll worker liveness so a worker that cannot
+        enqueue an error raises promptly instead of hanging the
+        training loop forever.  Two distinct deaths are caught:
+
+        - SIGKILLed/segfaulted (nonzero exitcode): the OOM killer or a
+          native crash — surfaced via :meth:`_raise_worker` naming the
+          worker, within one poll interval.
+        - exited *cleanly* without delivering the awaited batch (e.g.
+          ``sys.exit(0)`` from dataset code): every worker dead + an
+          empty queue used to block forever when ``timeout`` was None
+          (the default) — now it raises after one grace drain."""
         waited = 0.0
-        poll = 2.0
+        poll = 0.5
         while True:
             try:
                 return self.result_q.get(
                     timeout=poll if self.timeout is None
-                    else min(poll, self.timeout - waited))
+                    else min(poll, max(0.05, self.timeout - waited)))
             except pyqueue.Empty:
                 waited += poll
-                dead = [p for p in self._procs
+                dead = [(w, p.exitcode)
+                        for w, p in enumerate(self._procs)
                         if not p.is_alive() and p.exitcode not in (0, None)]
                 if dead:
-                    codes = [p.exitcode for p in dead]
+                    wid, code = dead[0]
+                    self._raise_worker(
+                        wid, f"worker process died (exitcode {code}) — "
+                             f"killed by the OS (OOM?) or a native "
+                             f"crash; no traceback could be sent")
+                if self._procs and \
+                        all(not p.is_alive() for p in self._procs):
+                    # grace drain: a result flushed just before the
+                    # last clean exit may still be in the pipe
+                    try:
+                        return self.result_q.get(timeout=1.0)
+                    except pyqueue.Empty:
+                        pass
                     self._shutdown()
                     raise RuntimeError(
-                        f"DataLoader worker(s) died unexpectedly "
-                        f"(exitcode(s) {codes}) — killed by the OS "
-                        f"(OOM?) or a native crash")
+                        "all DataLoader workers exited without "
+                        "producing the awaited batch")
                 if self.timeout is not None and waited >= self.timeout:
                     self._shutdown()
                     raise RuntimeError(

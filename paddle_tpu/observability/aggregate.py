@@ -98,7 +98,7 @@ class StorePublisher:
             try:
                 self.tick()
             except Exception:
-                pass            # a flaky store must not kill training
+                pass    # silent-ok: a flaky store must not kill training
             self._stop.wait(interval_s)
 
     def stop(self):

@@ -102,7 +102,7 @@ def _cost_analysis(fn, args, kwargs, allow_compile=False):
         try:
             ca = get()
         except Exception:
-            continue
+            continue    # silent-ok: cost analysis is optional telemetry
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else None
         if not isinstance(ca, dict):

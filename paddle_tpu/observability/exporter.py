@@ -31,6 +31,10 @@ enforces that):
                 drain, backpressure window, live engine health) and
                 the ``router_*`` counters — 404 when no router is
                 attached
+  ``/integrity``  the silent-corruption sentinel: fingerprint/replay
+                check counts, last cross-rank-verified step, active
+                divergence state and recent events — 404 when no
+                sentinel is attached
   ===========  ========================================================
 
   ``port=0`` binds an ephemeral port (read it back from
@@ -175,7 +179,7 @@ class ResourceSampler:
             try:
                 self.sample_once()
             except Exception:
-                pass                        # sampling must never kill ops
+                pass    # silent-ok: sampling must never kill the process
             self._stop.wait(self.interval_s)
 
     def stop(self):
@@ -239,6 +243,13 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(srv.router.fleet_status(),
                                                default=str))
+            elif url.path == "/integrity":
+                if srv.integrity is None:
+                    self._send(404, json.dumps(
+                        {"error": "no integrity sentinel attached"}))
+                else:
+                    self._send(200, json.dumps(srv.integrity.report(),
+                                               default=str))
             else:
                 self._send(404, json.dumps({"error": "not found",
                                             "path": url.path}))
@@ -257,7 +268,8 @@ class TelemetryServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, registry, tracer, engine, watchdog,
-                 aggregator=None, flight=None, hang=None, router=None):
+                 aggregator=None, flight=None, hang=None, router=None,
+                 integrity=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
@@ -267,6 +279,7 @@ class TelemetryServer(ThreadingHTTPServer):
         self.flight = flight
         self.hang = hang
         self.router = router
+        self.integrity = integrity
         self._serve_thread = None
 
     # ---- payload builders ----------------------------------------------
@@ -320,11 +333,21 @@ class TelemetryServer(ThreadingHTTPServer):
         else:
             g = gauge_value("hang_watchdog_active")
             hang_active = bool(g) if g is not None else None
+        # integrity fold: 503 while a CONFIRMED state divergence on
+        # this rank is unrepaired (the sentinel clears it once a later
+        # cross-rank compare matches again); absent signal = healthy
+        if self.integrity is not None:
+            divergence = bool(self.integrity.divergence_active)
+        else:
+            g = gauge_value("integrity_divergence_active")
+            divergence = bool(g) if g is not None else None
         out["training_healthy"] = training
         out["hang_active"] = hang_active
+        out["integrity_divergence_active"] = divergence
         out["healthy"] = (bool(out.get("healthy", True))
                           and training is not False
-                          and not hang_active)
+                          and not hang_active
+                          and not divergence)
         return out
 
     def flightz(self):
@@ -380,7 +403,7 @@ class TelemetryServer(ThreadingHTTPServer):
 def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                            tracer=None, engine=None, watchdog=None,
                            aggregator=None, flight=None, hang=None,
-                           router=None):
+                           router=None, integrity=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -399,8 +422,13 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     active cross-rank hang.  ``router`` (a
     :class:`~paddle_tpu.serving.FleetRouter`) serves ``/fleet`` and
     switches the ``/healthz`` serving leg to the fleet fold: 503 only
-    when no replica can admit.  Never called on import anywhere in the
-    framework — telemetry is strictly opt-in.
+    when no replica can admit.  ``integrity`` (a
+    :class:`~paddle_tpu.resilience.integrity.IntegrityCallback`)
+    serves ``/integrity`` and makes ``/healthz`` go 503 while a
+    confirmed state divergence is unrepaired (without one the
+    ``integrity_divergence_active`` gauge is folded instead).  Never
+    called on import anywhere in the framework — telemetry is strictly
+    opt-in.
     """
     if tracer is None:
         if engine is not None and getattr(engine, "tracer", None):
@@ -412,5 +440,6 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     srv = TelemetryServer((host, int(port)),
                           registry or default_registry(), tracer,
                           engine, watchdog, aggregator=aggregator,
-                          flight=flight, hang=hang, router=router)
+                          flight=flight, hang=hang, router=router,
+                          integrity=integrity)
     return srv._start()

@@ -545,7 +545,7 @@ class HangWatchdog(StorePublisher):
                 try:
                     self.publish()
                 except Exception:
-                    pass
+                    pass    # silent-ok: a flaky store is not a hang
             try:
                 hbs = self.heartbeats()
             except Exception:

@@ -72,7 +72,7 @@ def device_peak_flops(device=None, table=None, default=None):
         d = device if device is not None else jax.local_devices()[0]
         kind = getattr(d, "device_kind", None) or d.platform
     except Exception:
-        pass
+        pass    # silent-ok: best-effort device probe; table fallback
     if env:
         return float(env), kind
     best = None

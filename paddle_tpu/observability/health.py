@@ -20,6 +20,16 @@ failure signatures:
     step wall-time z-score spikes — a stalling host, a recompiling
     step, a dying storage mount.
 
+Two further kinds arrive from OUTSIDE the monitor via
+:meth:`HealthMonitor.external_anomaly` — the integrity sentinel
+(``resilience.integrity``) reports ``param_divergence`` when this
+rank's parameter fingerprint disagrees with its dp peers (a rollback
+kind by default: the repair restores the last *verified* checkpoint
+and **replays** the same data rather than skipping it, since the data
+was fine and the state was not) and ``step_replay_mismatch`` when a
+re-executed step produced different bytes (never a rollback kind:
+replay cannot say which execution was right).
+
 A condition *fires once per onset*: while it stays true on consecutive
 steps it is "active" and not re-reported (an injected NaN batch is
 flagged exactly once even though every following loss is NaN too).  On
@@ -114,7 +124,8 @@ class HealthMonitor(TrainingCallback):
                  plateau_window=0, plateau_min_delta=1e-4,
                  watch_grad_norm=True, skip_first_steps=1,
                  recover_after=1, rollback_kinds=("non_finite_loss",
-                                                  "grad_spike"),
+                                                  "grad_spike",
+                                                  "param_divergence"),
                  max_rollbacks=3, registry=None, tracer=None, clock=None):
         super().__init__()
         if action not in _ACTIONS:
@@ -247,6 +258,18 @@ class HealthMonitor(TrainingCallback):
         return []
 
     # ---- event plumbing --------------------------------------------------
+    def external_anomaly(self, kind, detail, step):
+        """Report an anomaly detected by a subsystem OUTSIDE this
+        monitor's own signals (the integrity sentinel's
+        ``param_divergence`` / ``step_replay_mismatch``) through the
+        same counter/span/action machinery — including
+        ``action="rollback"`` for kinds in ``rollback_kinds``.  The
+        caller owns onset dedup; ``detail`` may carry
+        ``restore_before`` (bound the rollback's restore walk) and
+        ``rewind`` (replay the data instead of skipping it)."""
+        self._clean_streak = 0
+        self._report(kind, dict(detail), step)
+
     def _resolve(self, firing, step):
         fired_kinds = {kind for kind, _ in firing}
         new = [(k, d) for k, d in firing if k not in self._active]
@@ -287,8 +310,11 @@ class HealthMonitor(TrainingCallback):
                 # Model.fit executes this after the callback round for
                 # the step completes (so the checkpoint callback's
                 # bookkeeping for the poisoned step is already visible)
-                self.model._rollback_request = {"reason": kind,
-                                                "step": step}
+                req = {"reason": kind, "step": step}
+                for key in ("restore_before", "rewind"):
+                    if key in detail:
+                        req[key] = detail[key]
+                self.model._rollback_request = req
             return
         if self.action in ("warn", "rollback"):
             logger.warning(msg)

@@ -225,7 +225,7 @@ class Profiler:
             try:
                 jax.profiler.stop_trace()
             except Exception:
-                pass
+                pass    # silent-ok: device trace may already be stopped
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
 

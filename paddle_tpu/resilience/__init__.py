@@ -17,6 +17,12 @@ this package is the fault boundary that makes that survivable:
 - :mod:`.retry` — jittered exponential backoff (:func:`retry`,
   :func:`backoff_delays`) and :class:`Deadline`, adopted by the
   TCPStore client and the serving engine's per-request TTLs.
+- :mod:`.integrity` — the silent-corruption sentinel:
+  :func:`tree_fingerprint` per-leaf CRC32 digests compared across dp
+  ranks over the TCPStore, sampled step-replay verification, and the
+  ``param_divergence`` restore-and-replay repair
+  (:class:`IntegrityCallback`, exported lazily to keep the layer
+  stack acyclic).
 - :mod:`.supervisor` — :class:`TrainingSupervisor`: runs the trainer
   as a watched child process and autonomously relaunches it (jittered
   backoff, ``max_restarts`` budget, elastic-membership rendezvous),
@@ -33,6 +39,7 @@ from __future__ import annotations
 
 from .atomic import CRC32Writer, atomic_write  # noqa: F401
 from .checkpoint_manager import (  # noqa: F401
+    CheckpointAuditError,
     CheckpointManager,
     verify_checkpoint,
 )
@@ -56,13 +63,30 @@ from .supervisor import (  # noqa: F401
 
 __all__ = [
     "atomic_write", "CRC32Writer",
-    "CheckpointManager", "verify_checkpoint",
+    "CheckpointManager", "CheckpointAuditError", "verify_checkpoint",
+    "IntegrityCallback", "tree_fingerprint", "compare_digests",
     "FaultInjector", "FaultSpec", "SimulatedCrash", "fault_point",
     "install", "uninstall", "current_injector", "injected_faults",
     "install_from_env",
     "Deadline", "RetryError", "backoff_delays", "retry",
     "TrainingSupervisor", "ENV_RESUME_DIR", "ENV_ATTEMPT",
 ]
+
+_INTEGRITY_NAMES = {"IntegrityCallback", "tree_fingerprint",
+                    "compare_digests", "first_divergent_leaf",
+                    "majority_partition"}
+
+
+def __getattr__(name):
+    # integrity's sentinel callback needs the hapi hook surface (via
+    # observability.goodput); importing it lazily keeps this package
+    # importable from the bottom of the layer stack
+    if name in _INTEGRITY_NAMES:
+        from . import integrity
+
+        return getattr(integrity, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 # env-gated fault injection: inert unless PADDLE_TPU_FAULTS is set
 install_from_env()

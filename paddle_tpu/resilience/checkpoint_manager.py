@@ -21,6 +21,18 @@ manifest; this module owns everything around that write that makes a
 - **retention** — ``keep_last_n`` garbage-collects old committed steps
   after each successful commit (tmp droppings from crashed saves are
   swept opportunistically too).
+- **audit-on-save** — ``save(..., verify=True)`` (or
+  ``verify_on_save=True`` on the manager) re-reads the committed
+  shards and re-checks every manifest CRC *before* retention GC runs.
+  A save whose bytes rotted between write and commit (controller
+  bitflip, lying disk cache) raises :class:`CheckpointAuditError` with
+  the old checkpoints untouched — a corrupted save can never become
+  the only restore candidate.
+- **discard** — :meth:`CheckpointManager.discard_after` removes
+  committed checkpoints NEWER than a step: the integrity sentinel's
+  restore-and-replay repair uses it to drop saves taken after a silent
+  corruption (intact CRC-wise, numerically poisoned), so a crash
+  mid-repair can't resume from one of them.
 - **async save** — ``async_save=True`` snapshots the tree to host
   memory synchronously and writes + commits on a background thread;
   :meth:`wait` joins it and re-raises its failure.  The training
@@ -46,9 +58,23 @@ import zlib
 
 from .faults import fault_point
 
-__all__ = ["CheckpointManager", "verify_checkpoint"]
+__all__ = ["CheckpointManager", "CheckpointAuditError",
+           "verify_checkpoint"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointAuditError(RuntimeError):
+    """A just-committed checkpoint failed its post-commit audit
+    (``save(verify=True)``).  The previous good checkpoints were NOT
+    garbage-collected."""
+
+    def __init__(self, step, errors):
+        super().__init__(
+            f"checkpoint step {step} failed post-commit audit: "
+            + "; ".join(errors) + " — old checkpoints were not GC'd")
+        self.step = int(step)
+        self.errors = list(errors)
 
 
 def _step_dirname(step):
@@ -123,10 +149,11 @@ class CheckpointManager:
     """Atomic, checksummed, retained checkpoints under one directory."""
 
     def __init__(self, directory, keep_last_n=None, async_save=False,
-                 sweep_orphans=True):
+                 sweep_orphans=True, verify_on_save=False):
         self.directory = os.fspath(directory)
         self.keep_last_n = keep_last_n
         self.async_save = bool(async_save)
+        self.verify_on_save = bool(verify_on_save)
         self._thread = None
         self._error = None
         os.makedirs(self.directory, exist_ok=True)
@@ -172,14 +199,18 @@ class CheckpointManager:
         return None
 
     # --------------------------------------------------------------- save
-    def save(self, tree, step, extra=None):
+    def save(self, tree, step, extra=None, verify=None):
         """Checkpoint ``tree`` as ``step``.  With ``async_save`` the
         device→host snapshot happens now and the write/commit happens on
         a background thread (a previous in-flight save is joined first,
-        so saves never reorder)."""
+        so saves never reorder).  ``verify=True`` (default: the
+        manager's ``verify_on_save``) audits the committed bytes before
+        GC — see :class:`CheckpointAuditError`; an async audit failure
+        surfaces from :meth:`wait` / the next :meth:`save`."""
+        verify = self.verify_on_save if verify is None else bool(verify)
         if not self.async_save:
             self.wait()
-            self._write_and_commit(tree, step, extra)
+            self._write_and_commit(tree, step, extra, verify=verify)
             return self.step_path(step)
         # snapshot BEFORE joining the previous save: the caller's tree
         # is only guaranteed step-consistent right now — the join may
@@ -187,17 +218,17 @@ class CheckpointManager:
         host_tree = _host_snapshot(tree)
         self.wait()
         self._thread = threading.Thread(
-            target=self._bg_save, args=(host_tree, step, extra),
+            target=self._bg_save, args=(host_tree, step, extra, verify),
             name=f"ckpt-save-{step}", daemon=True)
         self._thread.start()
         return self.step_path(step)
 
-    def _bg_save(self, tree, step, extra):
+    def _bg_save(self, tree, step, extra, verify=False):
         import time
 
         t0 = time.perf_counter()
         try:
-            self._write_and_commit(tree, step, extra)
+            self._write_and_commit(tree, step, extra, verify=verify)
         except BaseException as e:          # surfaced by wait()/next save
             self._error = e
             return
@@ -223,7 +254,7 @@ class CheckpointManager:
         if err is not None:
             raise err
 
-    def _write_and_commit(self, tree, step, extra):
+    def _write_and_commit(self, tree, step, extra, verify=False):
         from ..distributed.checkpoint import save_sharded
 
         final = self.step_path(step)
@@ -244,6 +275,13 @@ class CheckpointManager:
         os.replace(tmp, final)              # THE commit point
         fault_point("checkpoint.after_commit", path=final)
         self._count("checkpoint_commits_total")
+        if verify:
+            # audit BEFORE retention: a save that fails its re-read
+            # must never cause the good checkpoints to be GC'd
+            ok, errors = verify_checkpoint(final)
+            if not ok:
+                self._count("checkpoint_audit_failures_total")
+                raise CheckpointAuditError(step, errors)
         self._gc()
 
     # ------------------------------------------------------------- restore
@@ -284,6 +322,23 @@ class CheckpointManager:
             else ""
         raise FileNotFoundError(
             f"no intact checkpoint under {self.directory!r}{detail}")
+
+    def discard_after(self, step):
+        """Remove committed checkpoints STRICTLY NEWER than ``step``.
+
+        The integrity repair path calls this after restoring a
+        verified-good checkpoint: saves taken between the corruption
+        and its detection pass CRC verification but hold poisoned
+        numbers, and until the replay overwrites them they would be
+        the newest restore candidates for any crash.  Returns the
+        removed step numbers."""
+        removed = []
+        for s in self.steps():
+            if s > int(step):
+                shutil.rmtree(self.step_path(s), ignore_errors=True)
+                removed.append(s)
+                self._count("checkpoint_discarded_total")
+        return removed
 
     # ----------------------------------------------------------- retention
     def _sweep_tmp(self):

@@ -15,15 +15,25 @@ Fault kinds:
     ``except Exception`` recovery code can't swallow it — exactly like
     a SIGKILL, nothing downstream of the site runs).
 ``torn_write``
-    Truncate the file named by the site's ``path`` to a seed-chosen
-    fraction of its bytes, then crash — a torn write only matters when
-    the process dies before completing it.
+    Truncate the file named by the site's ``path`` (for a directory
+    site, a seed-chosen file under it) to a seed-chosen fraction of
+    its bytes, then crash — a torn write only matters when the process
+    dies before completing it.
 ``io_error``
     Raise a transient ``OSError`` (recoverable: retry decorators and
     callers see a plain failure, the process survives).
 ``stall``
     Sleep ``stall_s`` seconds — an artificial host hiccup for deadline
     and watchdog paths.
+``bitflip``
+    Flip ONE seed-chosen bit and keep running — the silent-data-
+    corruption fault (a cosmic ray, a marginal HBM cell, a desynced
+    replica).  At a site passing ``tree=`` (a mutable ``{name: array}``
+    dict), a seed-chosen leaf (or ``FaultSpec(leaf=...)``) is replaced
+    with a one-bit-flipped copy; at a site passing ``path=``, one bit
+    of the file (for a directory, of a seed-chosen file under it) is
+    flipped in place.  Nothing is raised: detection is the integrity
+    sentinel's job (``resilience.integrity``), not the injector's.
 
 Everything is **off by default**: with no injector installed,
 ``fault_point`` is a dict lookup and a return.  Installation is
@@ -63,17 +73,22 @@ class FaultSpec:
     """Fire ``kind`` at the ``occurrence``-th hit (1-based) of ``site``.
 
     ``torn_frac`` overrides the seed-derived truncation fraction for
-    ``torn_write``; ``stall_s`` sets the ``stall`` duration."""
+    ``torn_write``; ``stall_s`` sets the ``stall`` duration; ``leaf``
+    pins a ``bitflip`` to a named tree leaf and ``bit`` to an exact bit
+    index (both seed-chosen when unset)."""
 
     def __init__(self, site, kind="kill", occurrence=1, torn_frac=None,
-                 stall_s=0.05):
-        if kind not in ("kill", "torn_write", "io_error", "stall"):
+                 stall_s=0.05, leaf=None, bit=None):
+        if kind not in ("kill", "torn_write", "io_error", "stall",
+                        "bitflip"):
             raise ValueError(f"unknown fault kind {kind!r}")
         self.site = site
         self.kind = kind
         self.occurrence = int(occurrence)
         self.torn_frac = torn_frac
         self.stall_s = stall_s
+        self.leaf = leaf
+        self.bit = bit
 
     def __repr__(self):
         return (f"FaultSpec({self.site!r}, {self.kind!r}, "
@@ -116,7 +131,55 @@ class FaultInjector:
             help="faults fired by the resilience fault injector",
             labelnames=("site", "kind")).labels(site=site, kind=kind).inc()
 
-    def on_fault_point(self, site, path=None):
+    def _file_of(self, path):
+        """The file a path-targeted fault mutates: the path itself, or
+        a seed-chosen file under a directory site (checkpoint commit
+        sites pass the committed directory)."""
+        if path is None or not os.path.exists(path):
+            return None
+        if not os.path.isdir(path):
+            return path
+        files = []
+        for dirpath, _, names in os.walk(path):
+            files.extend(os.path.join(dirpath, n) for n in sorted(names))
+        files = sorted(f for f in files if os.path.getsize(f) > 0)
+        if not files:
+            return None
+        return files[int(self._rng.integers(len(files)))]
+
+    def _bitflip(self, spec, path=None, tree=None):
+        import numpy as np
+
+        if tree is not None:
+            names = sorted(k for k, v in tree.items()
+                           if getattr(v, "size", 0))
+            if spec.leaf is not None and spec.leaf not in names:
+                raise KeyError(f"bitflip leaf {spec.leaf!r} not in tree "
+                               f"({names})")
+            if not names:
+                return
+            name = spec.leaf if spec.leaf is not None else \
+                names[int(self._rng.integers(len(names)))]
+            arr = np.array(tree[name], copy=True)       # host, writable
+            flat = arr.reshape(-1).view(np.uint8)
+            bit = (spec.bit if spec.bit is not None
+                   else int(self._rng.integers(flat.size * 8)))
+            flat[bit // 8] ^= np.uint8(1 << (bit % 8))
+            tree[name] = arr
+            return
+        target = self._file_of(path)
+        if target is None:
+            return
+        size = os.path.getsize(target)
+        bit = (spec.bit if spec.bit is not None
+               else int(self._rng.integers(size * 8)))
+        with open(target, "r+b") as f:
+            f.seek(bit // 8)
+            b = f.read(1)
+            f.seek(bit // 8)
+            f.write(bytes([b[0] ^ (1 << (bit % 8))]))
+
+    def on_fault_point(self, site, path=None, tree=None):
         occ = self._hits.get(site, 0) + 1
         self._hits[site] = occ
         for spec in self.specs:
@@ -126,11 +189,12 @@ class FaultInjector:
             if spec.kind == "kill":
                 raise SimulatedCrash(site, occ)
             if spec.kind == "torn_write":
-                if path is not None and os.path.exists(path):
-                    size = os.path.getsize(path)
+                target = self._file_of(path)
+                if target is not None:
+                    size = os.path.getsize(target)
                     frac = (spec.torn_frac if spec.torn_frac is not None
                             else float(self._rng.uniform(0.1, 0.9)))
-                    with open(path, "r+b") as f:
+                    with open(target, "r+b") as f:
                         f.truncate(max(0, int(size * frac)))
                 raise SimulatedCrash(site, occ)
             if spec.kind == "io_error":
@@ -138,6 +202,8 @@ class FaultInjector:
                               f"(occurrence {occ})")
             if spec.kind == "stall":
                 time.sleep(spec.stall_s)
+            if spec.kind == "bitflip":
+                self._bitflip(spec, path=path, tree=tree)
 
 
 _injector: FaultInjector | None = None
@@ -169,11 +235,13 @@ def injected_faults(*specs, seed=0):
         uninstall()
 
 
-def fault_point(site, path=None):
+def fault_point(site, path=None, tree=None):
     """Declare a named fault site.  No-op unless an injector is
-    installed AND a spec matches this site at the current hit count."""
+    installed AND a spec matches this site at the current hit count.
+    ``tree`` (a mutable ``{name: array}`` dict) exposes live state to
+    the ``bitflip`` kind — the caller must write replaced leaves back."""
     if _injector is not None:
-        _injector.on_fault_point(site, path=path)
+        _injector.on_fault_point(site, path=path, tree=tree)
 
 
 def install_from_env(var="PADDLE_TPU_FAULTS"):

@@ -175,7 +175,7 @@ class TrainingSupervisor:
         span = self.tracer().start_trace(
             "supervisor::launch",
             attributes={"attempt": attempt, "pid": child.pid,
-                        "resume_step": self._resume_step()})
+                        **self._resume_evidence()})
         span.end()
         self._child_up(True)
         return child
@@ -202,6 +202,37 @@ class TrainingSupervisor:
             return CheckpointManager(self.checkpoint_dir).latest()
         except OSError:
             return None
+
+    def _resume_evidence(self):
+        """Resume step plus the newest manifest's recovery history:
+        skipped data windows (poisoned-batch rollbacks) and integrity
+        repairs (silent-corruption rewind-and-replay) ride in the
+        checkpoint ``extra``, so the supervisor's relaunch telemetry
+        records what the previous life of this trainer already
+        survived — not just where it resumes."""
+        step = self._resume_step()
+        out = {"resume_step": step}
+        if step is None:
+            return out
+        try:
+            from ..distributed.checkpoint import _load_manifest
+            from .checkpoint_manager import CheckpointManager
+
+            extra = _load_manifest(
+                CheckpointManager(
+                    self.checkpoint_dir,
+                    sweep_orphans=False).step_path(step)).get("extra", {})
+        except (OSError, ValueError, KeyError):
+            return out
+        windows = extra.get("skipped_windows") or []
+        repairs = extra.get("repairs") or []
+        if windows:
+            out["skipped_windows"] = len(windows)
+            out["last_rollback_reason"] = windows[-1].get("reason")
+        if repairs:
+            out["integrity_repairs"] = len(repairs)
+            out["last_repair_reason"] = repairs[-1].get("reason")
+        return out
 
     # ---- membership -----------------------------------------------------
     def _rendezvous(self):
@@ -264,7 +295,8 @@ class TrainingSupervisor:
         try:
             self.hang_watchdog.reset()
         except Exception:
-            pass
+            pass    # silent-ok: advisory reset — the relaunch
+                    # re-baselines against stale heartbeats regardless
 
     def _watch(self, child):
         """Block until the child exits, membership breaks, or the hang
@@ -341,7 +373,7 @@ class TrainingSupervisor:
                     "supervisor::relaunch",
                     attributes={"reason": reason, "attempt": self.attempt,
                                 "exit_code": code, "backoff_s": backoff,
-                                "resume_step": self._resume_step()})
+                                **self._resume_evidence()})
                 span.end()
                 logger.warning(
                     "supervisor: trainer %s (exit %s) — relaunching "

@@ -300,7 +300,7 @@ class FleetRouter:
             # has nothing left to salvage
             rep.engine.evacuate()
         except Exception:
-            pass
+            pass    # silent-ok: a hard-dead engine has nothing to free
         for freq in reversed(moved):
             freq.state = FleetRequestState.PENDING
             freq.replica_id = None

@@ -1,0 +1,179 @@
+"""GradScaler dynamic loss-scaling tests: inf/NaN grads must skip the
+optimizer step and decay the scale, clean steps must recover scale
+growth — the state machine that keeps fp16 training alive had no tier-1
+coverage (test_collective_amp.py only checks defaults and the jit
+guard, and does not collect on jax builds without ``jax.shard_map``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp.grad_scaler import GradScaler
+from paddle_tpu.core.tensor import Tensor
+
+
+def _setup(lr=0.1, **scaler_kw):
+    paddle.seed(3)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=lin.parameters())
+    return lin, opt, GradScaler(**scaler_kw)
+
+
+def _params_bytes(lin):
+    return [np.asarray(p.data).tobytes() for p in lin.parameters()]
+
+
+def _set_grads(lin, value):
+    for p in lin.parameters():
+        p.grad = Tensor(jnp.full(p.data.shape, value, p.data.dtype))
+
+
+class TestSkipOnNonFinite:
+    @pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+    def test_bad_grads_skip_the_optimizer_step(self, bad):
+        lin, opt, s = _setup(init_loss_scaling=1024.0,
+                             decr_every_n_nan_or_inf=2)
+        before = _params_bytes(lin)
+        _set_grads(lin, bad)
+        s.step(opt)
+        # the step was skipped: params are bitwise untouched, and the
+        # first bad step alone does not yet decay the scale
+        assert _params_bytes(lin) == before
+        assert s.get_loss_scaling() == 1024.0
+
+    def test_scale_halves_after_decr_every_bad_steps(self):
+        lin, opt, s = _setup(init_loss_scaling=1024.0,
+                             decr_every_n_nan_or_inf=2)
+        for _ in range(2):
+            _set_grads(lin, np.inf)
+            s.step(opt)
+        assert s.get_loss_scaling() == 512.0
+        # the bad-step counter reset: one more bad step doesn't halve
+        _set_grads(lin, np.inf)
+        s.step(opt)
+        assert s.get_loss_scaling() == 512.0
+
+    def test_scale_floors_at_one(self):
+        lin, opt, s = _setup(init_loss_scaling=2.0,
+                             decr_every_n_nan_or_inf=1)
+        for _ in range(4):
+            _set_grads(lin, np.nan)
+            s.step(opt)
+        assert s.get_loss_scaling() == 1.0
+        # the finite check still runs at the floor (dynamic scaling on):
+        # a clean step applies normally
+        before = _params_bytes(lin)
+        _set_grads(lin, 0.5)
+        s.step(opt)
+        assert _params_bytes(lin) != before
+
+    def test_real_overflow_through_minimize(self):
+        """End to end through scale()/backward: an inf input poisons
+        the grads and minimize() must leave the params untouched."""
+        lin, opt, s = _setup(init_loss_scaling=256.0)
+        before = _params_bytes(lin)
+        loss = s.scale(lin(Tensor(jnp.full((2, 4), jnp.inf))).sum())
+        s.minimize(opt, loss)
+        assert _params_bytes(lin) == before
+        # clean batch afterwards trains normally
+        loss = s.scale(lin(Tensor(jnp.ones((2, 4)))).sum())
+        s.minimize(opt, loss)
+        assert _params_bytes(lin) != before
+
+
+class TestRecovery:
+    def test_scale_regrows_after_incr_every_clean_steps(self):
+        lin, opt, s = _setup(init_loss_scaling=1024.0,
+                             decr_every_n_nan_or_inf=1,
+                             incr_every_n_steps=3, incr_ratio=2.0)
+        _set_grads(lin, np.inf)
+        s.step(opt)
+        assert s.get_loss_scaling() == 512.0
+        for i in range(3):
+            _set_grads(lin, 0.1)
+            s.step(opt)
+            # growth happens exactly AT the Nth clean step, not before
+            assert s.get_loss_scaling() == (1024.0 if i == 2 else 512.0)
+
+    def test_bad_step_resets_the_clean_streak(self):
+        lin, opt, s = _setup(init_loss_scaling=512.0,
+                             decr_every_n_nan_or_inf=2,
+                             incr_every_n_steps=2, incr_ratio=2.0)
+        _set_grads(lin, 0.1)
+        s.step(opt)
+        _set_grads(lin, np.nan)
+        s.step(opt)                 # streak broken (scale not yet cut)
+        _set_grads(lin, 0.1)
+        s.step(opt)
+        assert s.get_loss_scaling() == 512.0    # 1 clean, not 2
+        _set_grads(lin, 0.1)
+        s.step(opt)
+        assert s.get_loss_scaling() == 1024.0
+
+
+class TestUnscaleFlow:
+    def test_unscale_divides_grads_by_the_scale(self):
+        lin, opt, s = _setup(init_loss_scaling=64.0)
+        loss = s.scale(lin(Tensor(jnp.ones((2, 4)))).sum())
+        loss.backward()
+        scaled = [np.asarray(p.grad.data).copy()
+                  for p in opt._parameter_list]
+        s.unscale_(opt)
+        for p, g_scaled in zip(opt._parameter_list, scaled):
+            np.testing.assert_allclose(np.asarray(p.grad.data),
+                                       g_scaled / 64.0, rtol=1e-6)
+
+    def test_double_unscale_raises(self):
+        lin, opt, s = _setup(init_loss_scaling=64.0)
+        _set_grads(lin, 0.1)
+        s.unscale_(opt)
+        with pytest.raises(RuntimeError, match="already been called"):
+            s.unscale_(opt)
+        # step() clears the latch for the next iteration
+        s.step(opt)
+        _set_grads(lin, 0.1)
+        s.unscale_(opt)
+
+    def test_matches_unscaled_reference_run(self):
+        """A scaled clean step must land within float tolerance of an
+        unscaled run from the same init — scaling is numerically
+        transparent when nothing overflows."""
+        def run(scaler):
+            lin, opt, s = _setup(init_loss_scaling=scaler)
+            for _ in range(3):
+                loss = s.scale(lin(Tensor(jnp.ones((2, 4)))).sum())
+                s.minimize(opt, loss)
+            return [np.asarray(p.data) for p in lin.parameters()]
+
+        for a, b in zip(run(1.0), run(4096.0)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestState:
+    def test_state_dict_roundtrip(self):
+        _, opt, s = _setup(init_loss_scaling=1024.0,
+                           decr_every_n_nan_or_inf=2)
+        lin2, opt2, s2 = _setup(init_loss_scaling=1024.0,
+                                decr_every_n_nan_or_inf=2)
+        _set_grads(lin2, np.inf)
+        s2.step(opt2)
+        state = s2.state_dict()
+        assert state["bad_steps"] == 1
+        s.load_state_dict(state)
+        # the restored scaler continues the decay exactly where the
+        # saved one stopped: one more bad step halves
+        lin, opt, _ = _setup()
+        s._unscaled = False
+        _set_grads(lin, np.inf)
+        s.step(opt)
+        assert s.get_loss_scaling() == 512.0
+
+    def test_disabled_scaler_passes_through(self):
+        lin, opt, s = _setup(enable=False)
+        before = _params_bytes(lin)
+        _set_grads(lin, 0.1)
+        s.step(opt)                     # plain optimizer.step()
+        assert _params_bytes(lin) != before
+        assert s.scale(2.0) == 2.0

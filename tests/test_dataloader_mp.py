@@ -191,8 +191,55 @@ class TestWorkerSafety:
                 return np.ones(2, np.float32)
 
         loader = DataLoader(Killer(), batch_size=4, num_workers=2)
-        with pytest.raises(RuntimeError, match="died unexpectedly"):
+        with pytest.raises(RuntimeError, match="worker process died"):
             _collect(loader)
+
+    def test_killed_worker_error_names_the_worker_promptly(self):
+        """A SIGKILLed worker must be named in the error (which worker
+        to look at in the OOM-killer log) and surface within the
+        liveness-poll budget, not after a long timeout."""
+        class Killer(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                from paddle_tpu.io.multiprocess import get_worker_info
+
+                if get_worker_info().id == 1:
+                    os._exit(137)
+                time.sleep(0.01)
+                return np.ones(2, np.float32)
+
+        loader = DataLoader(Killer(), batch_size=4, num_workers=2)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError,
+                           match=r"DataLoader worker 1 failed") as ei:
+            _collect(loader)
+        assert "exitcode 137" in str(ei.value)
+        assert time.monotonic() - t0 < 20.0
+
+    def test_clean_exit_without_batch_raises_not_hangs(self):
+        """Regression: a worker that exits CLEANLY mid-epoch (exitcode
+        0 — dataset code calling sys.exit) left _get() blocking forever
+        with the default timeout=None, because the liveness poll only
+        looked for nonzero exit codes.  All-dead + empty queue must
+        raise."""
+        class CleanExit(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                if i >= 4:
+                    os._exit(0)        # clean death, no "done" marker
+                time.sleep(0.01)
+                return np.ones(2, np.float32)
+
+        loader = DataLoader(CleanExit(), batch_size=4, num_workers=2)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError,
+                           match="without producing the awaited batch"):
+            _collect(loader)
+        assert time.monotonic() - t0 < 20.0
 
     def test_iterable_early_break_unlinks_worker_held_shm(self):
         """Iterable mode + bounded queue: a worker blocked in put() holds
