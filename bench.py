@@ -860,6 +860,38 @@ def bench_integrity(steps=20, fp_reps=9, replay_reps=5, hidden=1024,
     return out
 
 
+def bench_lint(reps=3):
+    """Static-analysis suite cost: wall time of the unified
+    ``python -m tools.analysis`` run (all passes over one shared
+    parsed-module cache), so lint cost shows up in the perf trajectory
+    alongside everything else.  Each rep builds a FRESH Project — the
+    one-pass parse cache is part of what is being measured.  The tier-1
+    budget this must stay under is 10s."""
+    from tools.analysis.core import Project, run_all
+
+    walls, report = [], None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        report = run_all(Project())
+        walls.append(time.perf_counter() - t0)
+    wall_s = float(np.median(walls))
+    out = {
+        "passes": len(report["passes"]),
+        "files_scanned": report["files_scanned"],
+        "new_findings": len(report["new"]),
+        "baselined_findings": len(report["baselined"]),
+        "wall_seconds_p50": wall_s,
+        "budget_seconds": 10.0,
+        "per_pass_seconds": {rule: stats["seconds"]
+                             for rule, stats in report["passes"].items()},
+    }
+    log(f"[lint] {out['passes']} passes over {out['files_scanned']} "
+        f"files in {wall_s:.2f}s (budget 10s), "
+        f"{out['new_findings']} new / {out['baselined_findings']} "
+        f"baselined findings")
+    return out
+
+
 def _timed(fn, *args):
     t0 = time.perf_counter()
     fn(*args)
@@ -1033,7 +1065,7 @@ def main():
     ap.add_argument("--section",
                     choices=["gpt", "rung", "flash", "resnet", "ps",
                              "serving", "fleet", "resilience",
-                             "distributed", "integrity"],
+                             "distributed", "integrity", "lint"],
                     help="internal: run ONE section in-process, print "
                          "its JSON")
     ap.add_argument("--rung", type=int, default=0,
@@ -1085,6 +1117,9 @@ def main():
         return
     if args.section == "integrity":
         print(json.dumps(_section_telemetry(bench_integrity())))
+        return
+    if args.section == "lint":
+        print(json.dumps(_section_telemetry(bench_lint())))
         return
 
     # ---- orchestrator: every section in its own subprocess ----
@@ -1149,6 +1184,8 @@ def main():
                                         timeout_s=600, tag="distributed")
     extra["integrity"] = _run_section(["--section", "integrity"],
                                       timeout_s=600, tag="integrity")
+    extra["lint"] = _run_section(["--section", "lint"],
+                                 timeout_s=300, tag="lint")
 
     # ---- regression gate: >5% drop vs any prior round fails the bench
     best = prior_best()

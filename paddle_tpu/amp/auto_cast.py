@@ -32,6 +32,8 @@ white_list = WHITE_LIST  # re-export name parity
 
 
 class _AmpState(threading.local):
+    # thread-local by design (one autocast stack per thread): no
+    # guarded-by annotations — no attribute here is ever cross-thread
     def __init__(self):
         self.enabled = False
         self.dtype = jnp.bfloat16

@@ -24,6 +24,8 @@ __all__ = ["no_grad", "enable_grad", "is_grad_enabled", "TapeNode",
 
 
 class _GradMode(threading.local):
+    # thread-local by design (no_grad nesting is per-thread): no
+    # guarded-by annotations — no attribute here is ever cross-thread
     def __init__(self):
         self.enabled = True
 

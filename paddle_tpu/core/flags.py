@@ -14,7 +14,7 @@ import threading
 __all__ = ["define_flag", "get_flags", "set_flags", "flag"]
 
 _lock = threading.Lock()
-_registry: dict[str, dict] = {}
+_registry: dict[str, dict] = {}     # guarded-by: _lock
 
 
 def _coerce(value, proto):
@@ -42,15 +42,22 @@ def define_flag(name: str, default, help_str: str = ""):
 
 def flag(name: str):
     """Read a flag's current value."""
+    # lint-ok: trace-purity flags are static config by contract: a
+    # trace-time read (e.g. kernel selection) intentionally freezes
+    # the value into that compile
+    # lint-ok: lock-discipline eager-op hot path: one GIL-atomic dict
+    # lookup of a value set_flags replaces atomically; a lock here
+    # would serialize every op dispatch
     return _registry[name]["value"]
 
 
 def get_flags(names=None):
-    if names is None:
-        names = list(_registry)
-    if isinstance(names, str):
-        names = [names]
-    return {n: _registry[n]["value"] for n in names}
+    with _lock:
+        if names is None:
+            names = list(_registry)
+        if isinstance(names, str):
+            names = [names]
+        return {n: _registry[n]["value"] for n in names}
 
 
 def set_flags(mapping: dict):
@@ -93,13 +100,16 @@ def apply_allocator_flags():
     own XLA_PYTHON_CLIENT_* variables at import."""
     import os
 
-    if _registry["fraction_of_device_memory_to_use"]["explicit"]:
+    with _lock:
+        frac_explicit = _registry["fraction_of_device_memory_to_use"]["explicit"]
+        strategy_explicit = _registry["allocator_strategy"]["explicit"]
+    if frac_explicit:
         frac = flag("fraction_of_device_memory_to_use")
         if frac and frac > 0:
             os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(frac)
         else:
             os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
-    if _registry["allocator_strategy"]["explicit"]:
+    if strategy_explicit:
         strategy = flag("allocator_strategy")
         if strategy == "preallocate":
             os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = "true"

@@ -127,6 +127,8 @@ def _devices_for(device_type: str):
 
 
 class _DeviceState(threading.local):
+    # thread-local by design (set_device scopes per thread): no
+    # guarded-by annotations — no attribute here is ever cross-thread
     def __init__(self):
         self.place = None
 

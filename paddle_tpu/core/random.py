@@ -38,6 +38,8 @@ class _Stream:
 
 
 class _RandomState(threading.local):
+    # thread-local by design (each thread owns its RNG streams): no
+    # guarded-by annotations — no attribute here is ever cross-thread
     def __init__(self):
         # streams are created LAZILY: building a jax PRNG key initializes
         # the jax backend, which must not happen at import time (the

@@ -66,7 +66,10 @@ class ElasticManager:
         self.node_timeout = node_timeout
         self._stop = threading.Event()
         self._thread = None
-        # host -> (last counter value, reader-side monotonic time it advanced)
+        # host -> (last counter value, reader-side monotonic time it advanced).
+        # Not lock-guarded by design: only the prober thread (the
+        # supervisor's watch loop) reads/writes it — the heartbeat
+        # thread touches the store, never this dict.
         self._seen = {}
 
     # ---------------------------------------------------------- membership

@@ -40,6 +40,8 @@ __all__ = ["moe_gating", "moe_ffn", "moe_layer", "MoELayer",
 def moe_capacity(num_tokens, num_experts, capacity_factor, top_k):
     """Static per-shard expert capacity (reference: MoELayer capacity arg +
     limit_by_capacity)."""
+    # lint-ok: trace-purity num_tokens is a static Python int derived
+    # from shapes; this arithmetic never touches a traced value
     return max(1, int(math.ceil(
         num_tokens / num_experts * capacity_factor * top_k)))
 

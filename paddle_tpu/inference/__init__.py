@@ -248,12 +248,17 @@ class Predictor:
             try:
                 for s in subs:
                     q = quantized[id(s)]
+                    # lint-ok: trace-purity intentional trace-time
+                    # dispatch patch; restored in finally before the
+                    # trace ends, so no state leaks across traces
                     s.forward = (lambda x, _q=q:
                                  Tensor(_q(x.data if isinstance(x, Tensor)
                                            else x)))
                 yield
             finally:
                 for s, f in zip(subs, saved):
+                    # lint-ok: trace-purity restores the pre-patch
+                    # forward (see the paired patch above)
                     s.forward = f
 
         # fp32 weights of quantized Linears would otherwise ride along as

@@ -147,8 +147,8 @@ class DataLoader:
 
         index_q: queue.Queue = queue.Queue()
         all_batches = list(self.batch_sampler)
-        results: dict[int, object] = {}
         results_lock = threading.Condition()
+        results: dict[int, object] = {}     # guarded-by: results_lock
         for i, b in enumerate(all_batches):
             index_q.put((i, b))
 

@@ -301,6 +301,8 @@ class MultiprocessIter:
           (the default) — now it raises after one grace drain."""
         waited = 0.0
         poll = 0.5
+        # lint-ok: bounded-retries unbounded-by-design when the user
+        # asked for timeout=None; dead workers raise via _raise_worker
         while True:
             try:
                 return self.result_q.get(

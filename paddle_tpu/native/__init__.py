@@ -13,7 +13,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
-_LIB = None
+_LIB = None     # guarded-by: _LOCK
 
 
 def _build(src, out):

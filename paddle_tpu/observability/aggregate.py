@@ -168,11 +168,17 @@ class ClusterAggregator:
         self.key_prefix = key_prefix
         self.skew_metric = skew_metric
         self._clock = clock or time.time
-        self._lock = threading.Lock()   # TCPStore client: one user at a time
-        self.stale_ranks = []
-        self.missing_ranks = []
-        self.last_skew_s = None
-        self._last = {}
+        # one lock, two jobs: serializes TCPStore client use AND makes
+        # (stale_ranks, missing_ranks, last_skew_s, _last) one
+        # consistent unit — the exporter's HTTP threads call
+        # merged_snapshot()/expose_prometheus() while a collect() is
+        # mid-update, and a torn combination (fresh _last with stale
+        # rank lists) used to be observable
+        self._lock = threading.Lock()
+        self.stale_ranks = []           # guarded-by: self._lock
+        self.missing_ranks = []         # guarded-by: self._lock
+        self.last_skew_s = None         # guarded-by: self._lock
+        self._last = {}                 # guarded-by: self._lock
 
     # ---- collection -----------------------------------------------------
     def _fetch_raw(self):
@@ -208,10 +214,19 @@ class ClusterAggregator:
                 stale.append(rank)
                 continue
             fresh[rank] = payload
-        self.stale_ranks, self.missing_ranks = stale, missing
-        self._last = fresh
-        self._update_fleet_gauges(fresh)
+        skew = self._skew_of(fresh)
+        with self._lock:
+            self.stale_ranks, self.missing_ranks = stale, missing
+            self.last_skew_s = skew
+            self._last = fresh
+        self._publish_fleet_gauges(fresh, stale, missing, skew)
         return fresh
+
+    def _state(self):
+        """One consistent point-in-time read of the last collect."""
+        with self._lock:
+            return (self._last, self.stale_ranks, self.missing_ranks,
+                    self.last_skew_s)
 
     def _rank_step_means(self, fresh):
         out = {}
@@ -224,33 +239,37 @@ class ClusterAggregator:
                 out[rank] = v
         return out
 
-    def _update_fleet_gauges(self, fresh):
+    def _skew_of(self, fresh):
         means = self._rank_step_means(fresh)
-        self.last_skew_s = (max(means.values()) - min(means.values())
-                            if len(means) >= 2 else None)
+        return (max(means.values()) - min(means.values())
+                if len(means) >= 2 else None)
+
+    def _publish_fleet_gauges(self, fresh, stale, missing, skew):
         reg = self.registry
-        if self.last_skew_s is not None:
+        if skew is not None:
             reg.gauge(
                 "training_step_time_skew_seconds",
                 "max - min of per-rank mean step time (straggler skew)"
-            ).set(self.last_skew_s)
+            ).set(skew)
         reg.gauge("cluster_ranks_reporting",
                   "ranks with a fresh metrics snapshot").set(len(fresh))
         reg.gauge("cluster_ranks_stale",
                   "ranks whose snapshot aged out (or never arrived)"
-                  ).set(len(self.stale_ranks) + len(self.missing_ranks))
+                  ).set(len(stale) + len(missing))
 
     # ---- rendering ------------------------------------------------------
     def merged_snapshot(self, collect=True):
         """JSON-able fleet view: per-rank snapshots + staleness + skew
         (the telemetry server's ``/varz`` embeds this as ``cluster``)."""
-        fresh = self.collect() if collect else self._last
+        if collect:
+            self.collect()
+        fresh, stale, missing, skew = self._state()
         return {
             "world_size": self.world_size,
             "ranks": {str(r): p for r, p in sorted(fresh.items())},
-            "stale_ranks": self.stale_ranks,
-            "missing_ranks": self.missing_ranks,
-            "step_time_skew_seconds": self.last_skew_s,
+            "stale_ranks": stale,
+            "missing_ranks": missing,
+            "step_time_skew_seconds": skew,
             "per_rank_step_mean_s": {
                 str(r): v
                 for r, v in sorted(self._rank_step_means(fresh).items())},
@@ -259,7 +278,9 @@ class ClusterAggregator:
     def expose_prometheus(self, collect=True):
         """Fleet-wide Prometheus text exposition, every series labelled
         ``rank="<r>"``.  Histogram snapshots render as summaries."""
-        fresh = self.collect() if collect else self._last
+        if collect:
+            self.collect()
+        fresh, stale, missing, skew = self._state()
         kinds, order = {}, []
         for _, payload in sorted(fresh.items()):
             for name, entry in payload.get("metrics", {}).items():
@@ -279,7 +300,8 @@ class ClusterAggregator:
                     continue    # one name, one kind; mismatches dropped
                 for labels, value in self._series_of(entry, rank):
                     lines.extend(self._render(pname, kind, labels, value))
-        lines.extend(self._fleet_lines(set(order)))
+        lines.extend(
+            self._fleet_lines(set(order), fresh, stale, missing, skew))
         return "\n".join(lines) + "\n"
 
     @staticmethod
@@ -319,16 +341,15 @@ class ClusterAggregator:
             return [_prom_line(pname, labels, value)]
         return []
 
-    def _fleet_lines(self, seen_names):
+    def _fleet_lines(self, seen_names, fresh, stale, missing, skew):
         """Fleet-level series (no rank label) appended after the merge —
         fresh from THIS collect, not one publish interval behind.  TYPE
         lines are skipped for names the merge already declared (rank 0
         republishes the fleet gauges from its local registry)."""
         lines = []
-        fleet = [("training_step_time_skew_seconds", self.last_skew_s),
-                 ("cluster_ranks_reporting", len(self._last)),
-                 ("cluster_ranks_stale",
-                  len(self.stale_ranks) + len(self.missing_ranks))]
+        fleet = [("training_step_time_skew_seconds", skew),
+                 ("cluster_ranks_reporting", len(fresh)),
+                 ("cluster_ranks_stale", len(stale) + len(missing))]
         for name, value in fleet:
             if value is None:
                 continue

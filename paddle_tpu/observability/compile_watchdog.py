@@ -176,7 +176,7 @@ class CompileWatchdog:
             "PADDLE_TPU_COMPILE_WATCHDOG", "") not in ("", "0", "false")
         self.cost_analysis = cost_analysis
         self._registry = registry
-        self._stats = {}
+        self._stats = {}        # guarded-by: self._lock
         self._lock = threading.Lock()
 
     # ---- lifecycle ------------------------------------------------------

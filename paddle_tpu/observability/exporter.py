@@ -116,12 +116,19 @@ class ResourceSampler:
     def __init__(self, interval_s=5.0, registry=None):
         self.interval_s = float(interval_s)
         self.registry = registry or default_registry()
-        self._gauges = None
+        # the sampler thread and synchronous sample_once() callers race
+        # on the lazy gauge build and the published sample
+        self._lock = threading.Lock()
+        self._gauges = None     # guarded-by: self._lock
         self._thread = None
         self._stop = threading.Event()
-        self._last = None
+        self._last = None       # guarded-by: self._lock
 
     def _ensure_gauges(self):
+        with self._lock:
+            return self._ensure_gauges_locked()
+
+    def _ensure_gauges_locked(self):
         if self._gauges is None:
             reg = self.registry
             self._gauges = {
@@ -154,14 +161,17 @@ class ResourceSampler:
             g["jax"].set(jax_bytes)
         for gen, n in gc_counts.items():
             g["gc"].labels(gen=gen).set(n)
-        self._last = {"rss_bytes": rss, "open_fds": fds,
-                      "gc_collections": gc_counts,
-                      "jax_live_buffer_bytes": jax_bytes}
-        return self._last
+        sample = {"rss_bytes": rss, "open_fds": fds,
+                  "gc_collections": gc_counts,
+                  "jax_live_buffer_bytes": jax_bytes}
+        with self._lock:
+            self._last = sample
+        return sample
 
     @property
     def last_sample(self):
-        return self._last
+        with self._lock:
+            return self._last
 
     # ---- thread ---------------------------------------------------------
     def start(self):

@@ -188,15 +188,15 @@ class FlightRecorder:
         self._tracer = tracer
         self.clock = clock or time.perf_counter
         self._lock = threading.Lock()
-        self._ring = []            # completed records, oldest first
-        self._inflight = []        # started, not yet finished
-        self._seq = 0              # global monotonic, assigned at start
-        self._group_seq = {}       # group label -> per-group seq
-        self._last_done_seq = 0    # last COMPLETED global seq
-        self._last_op = None
-        self._completed = 0        # lifetime count (ring evicts)
-        self.step = None
-        self.epoch = None
+        self._ring = []            # oldest first; guarded-by: self._lock
+        self._inflight = []        # unfinished; guarded-by: self._lock
+        self._seq = 0              # global monotonic; guarded-by: self._lock
+        self._group_seq = {}       # per-group seq; guarded-by: self._lock
+        self._last_done_seq = 0    # last COMPLETED; guarded-by: self._lock
+        self._last_op = None       # guarded-by: self._lock
+        self._completed = 0        # lifetime count; guarded-by: self._lock
+        self.step = None           # guarded-by: self._lock
+        self.epoch = None          # guarded-by: self._lock
 
     # ---- wiring ---------------------------------------------------------
     def registry(self):
@@ -212,10 +212,20 @@ class FlightRecorder:
     # ---- progress -------------------------------------------------------
     def note_step(self, step, epoch=None):
         """Training-step progress heartbeat (``Model.fit`` calls this
-        once per batch); rides the hang watchdog's heartbeat payload."""
-        self.step = int(step)
-        if epoch is not None:
-            self.epoch = int(epoch)
+        once per batch); rides the hang watchdog's heartbeat payload.
+        Locked so a heartbeat reader never sees a new step paired with
+        a stale epoch (the pair is written between two batches)."""
+        with self._lock:
+            self.step = int(step)
+            if epoch is not None:
+                self.epoch = int(epoch)
+
+    def progress(self):
+        """``(step, epoch)`` read under the lock — external readers
+        (the hang watchdog's heartbeat/bundle) must not see a torn
+        step/epoch pair mid-:meth:`note_step`."""
+        with self._lock:
+            return self.step, self.epoch
 
     # ---- record lifecycle -----------------------------------------------
     def start(self, op, group=None, tensors=(), caller=None):
@@ -467,8 +477,12 @@ class HangWatchdog(StorePublisher):
         self._tracer = tracer
         self.key_prefix = key_prefix
         self._mono = clock or time.monotonic
-        self._seen = {}            # rank -> (seq, mono_t_of_last_change)
+        # rank -> (seq, mono time it last advanced)
+        self._seen = {}            # guarded-by: self._plock
         self._plock = threading.Lock()
+        # sticky detection state: written only under _plock (poll /
+        # reset); lock-free reads by the exporter and supervisor are
+        # intentional — each is a single-attribute snapshot
         self.hang_active = False
         self.fired = 0
         self.last_desync = None
@@ -499,7 +513,8 @@ class HangWatchdog(StorePublisher):
                 "op": rec.last_op if rec is not None else None,
                 "inflight": (rec.inflight_brief()
                              if rec is not None else None),
-                "step": rec.step if rec is not None else None,
+                "step": (rec.progress()[0]
+                         if rec is not None else None),
                 "wall": self._clock()}
 
     def heartbeats(self):
@@ -550,10 +565,11 @@ class HangWatchdog(StorePublisher):
                 hbs = self.heartbeats()
             except Exception:
                 return self.hang_active
-            self._evaluate(hbs)
+            self._evaluate_locked(hbs)
             return self.hang_active
 
-    def _evaluate(self, hbs):
+    def _evaluate_locked(self, hbs):
+        # caller holds self._plock (the _locked suffix is the contract)
         now = self._mono()
         for r, hb in hbs.items():
             seq = int(hb.get("seq", 0))
@@ -576,9 +592,9 @@ class HangWatchdog(StorePublisher):
         stalled = [r for r in lagging
                    if now - self._seen[r][1] >= self.stall_timeout_s]
         if stalled and not self.hang_active:
-            self._fire(stalled, seqs, hbs)
+            self._fire_locked(stalled, seqs, hbs)
 
-    def _fire(self, stalled, seqs, hbs):
+    def _fire_locked(self, stalled, seqs, hbs):
         self.hang_active = True
         self.fired += 1
         lag = min(stalled, key=lambda r: seqs[r])
@@ -642,7 +658,7 @@ class HangWatchdog(StorePublisher):
             "rank": self.rank,
             "reason": reason,
             "wall": self._clock(),
-            "step": rec.step if rec is not None else None,
+            "step": rec.progress()[0] if rec is not None else None,
             "desync": self.last_desync,
             "records": (rec.records(limit=self.bundle_records)
                         if rec is not None else []),

@@ -48,7 +48,7 @@ class _Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._labelvalues = ()
-        self._children = {}
+        self._children = {}     # guarded-by: self._lock
         self._lock = threading.Lock()
 
     # ---- family surface -------------------------------------------------
@@ -92,7 +92,7 @@ class Counter(_Metric):
 
     def __init__(self, name, help="", labelnames=()):  # noqa: A002
         super().__init__(name, help, labelnames)
-        self._value = 0
+        self._value = 0         # guarded-by: self._lock
 
     def inc(self, n=1):
         self._check_scalar("inc")
@@ -117,8 +117,8 @@ class Gauge(_Metric):
 
     def __init__(self, name, help="", labelnames=()):  # noqa: A002
         super().__init__(name, help, labelnames)
-        self._value = 0.0
-        self._peak = 0.0
+        self._value = 0.0       # guarded-by: self._lock
+        self._peak = 0.0        # guarded-by: self._lock
 
     def set(self, v):
         self._check_scalar("set")
@@ -164,11 +164,11 @@ class Histogram(_Metric):
         super().__init__(name, help, labelnames)
         self._bucket_args = (start, factor, count, reservoir)
         self.buckets = [start * factor ** i for i in range(count)]
-        self.counts = [0] * (count + 1)          # +1 for the overflow bucket
-        self.total = 0
-        self.sum = 0.0
+        self.counts = [0] * (count + 1)  # overflow bucket; guarded-by: self._lock
+        self.total = 0          # guarded-by: self._lock
+        self.sum = 0.0          # guarded-by: self._lock
         self._reservoir = reservoir
-        self._samples = []
+        self._samples = []      # guarded-by: self._lock
 
     def _make_child(self):
         start, factor, count, reservoir = self._bucket_args
@@ -233,8 +233,8 @@ class MetricsRegistry:
     built metric in under an existing name — the reset idiom."""
 
     def __init__(self):
-        self._metrics = {}
-        self._collectors = []
+        self._metrics = {}      # guarded-by: self._lock
+        self._collectors = []   # guarded-by: self._lock
         self._lock = threading.RLock()
 
     # ---- collectors ------------------------------------------------------

@@ -117,11 +117,12 @@ class Tracer:
         self.clock = clock or time.perf_counter
         self.max_traces = int(max_traces)
         self._lock = threading.Lock()
-        self._next_trace_id = 1
-        self._next_span_id = 1
-        self._live = {}            # trace_id -> [Span, ...] (root first)
-        self._completed = []       # ring of trace dicts, oldest first
-        self._n_completed = 0      # lifetime count (ring evicts)
+        self._next_trace_id = 1    # guarded-by: self._lock
+        self._next_span_id = 1     # guarded-by: self._lock
+        # _live: trace_id -> [Span, ...] (root first)
+        self._live = {}            # guarded-by: self._lock
+        self._completed = []       # ring, oldest first; guarded-by: self._lock
+        self._n_completed = 0      # lifetime count; guarded-by: self._lock
 
     # ---- span lifecycle -------------------------------------------------
     def start_trace(self, name, attributes=None, start_s=None):
@@ -221,14 +222,20 @@ class Tracer:
         """Aggregate over the ring: lifetime completed count plus
         per-root-name count/total duration — the bench's embedded
         trace digest."""
+        # one locked read: the lifetime count and the ring must come
+        # from the same instant, or "completed" can lag a trace that
+        # "buffered" already shows (racing _end_span)
+        with self._lock:
+            completed = self._n_completed
+            ring = list(self._completed)
         by_name = {}
-        for tr in self.traces():
+        for tr in ring:
             # request#N / decode[i] collapse to one aggregate key each
             key = tr["name"].split("#")[0].split("[")[0]
             cnt, tot = by_name.get(key, (0, 0.0))
             by_name[key] = (cnt + 1, tot + tr["duration_s"])
-        return {"completed": self._n_completed,
-                "buffered": len(self.traces()),
+        return {"completed": completed,
+                "buffered": len(ring),
                 "by_name": {k: {"count": c, "total_s": t}
                             for k, (c, t) in sorted(by_name.items())}}
 

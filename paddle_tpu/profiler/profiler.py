@@ -92,8 +92,11 @@ class _HostEventRecorder:
     samples."""
 
     def __init__(self):
-        self.events = []
+        self.events = []        # guarded-by: self.lock
         self.lock = threading.Lock()
+        # lock-free sticky flag: record paths read it unlocked by
+        # design (a stale read costs one dropped/extra event, never a
+        # torn structure)
         self.enabled = False
 
     def record(self, name, start_ns, end_ns, tid):
@@ -360,6 +363,8 @@ def export_events_chrome(events, path, thread_names=None):
     d = os.path.dirname(os.path.abspath(path))
     if d:
         os.makedirs(d, exist_ok=True)
+    # lint-ok: atomic-writes chrome-trace export is a re-recordable
+    # log artifact, not durable state — a torn trace is cosmetic
     with open(path, "w") as f:
         json.dump(trace, f)
 

@@ -154,8 +154,11 @@ class CheckpointManager:
         self.keep_last_n = keep_last_n
         self.async_save = bool(async_save)
         self.verify_on_save = bool(verify_on_save)
+        # _thread is owned by the training thread (save/wait only);
+        # _error crosses from the background save thread into wait()
+        self._lock = threading.Lock()
         self._thread = None
-        self._error = None
+        self._error = None      # guarded-by: self._lock
         os.makedirs(self.directory, exist_ok=True)
         if sweep_orphans:
             # reclaim step_N.tmp debris from a save killed mid-write in
@@ -230,7 +233,8 @@ class CheckpointManager:
         try:
             self._write_and_commit(tree, step, extra, verify=verify)
         except BaseException as e:          # surfaced by wait()/next save
-            self._error = e
+            with self._lock:
+                self._error = e
             return
         # the overlapped (off-training-thread) write time: compare with
         # the sync/async series the CheckpointCallback records to see
@@ -250,7 +254,8 @@ class CheckpointManager:
         t, self._thread = self._thread, None
         if t is not None:
             t.join()
-        err, self._error = self._error, None
+        with self._lock:
+            err, self._error = self._error, None
         if err is not None:
             raise err
 

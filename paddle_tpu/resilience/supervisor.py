@@ -160,6 +160,9 @@ class TrainingSupervisor:
         fault_point("supervisor.spawn")
         logf = None
         if self.log_path:
+            # lint-ok: atomic-writes append-style run transcript that
+            # must be open BEFORE the child exists; a torn line is
+            # cosmetic
             logf = open(self.log_path, "a" if attempt else "w")
             if attempt:
                 logf.write(f"\n----- restart attempt {attempt} -----\n")
@@ -304,6 +307,8 @@ class TrainingSupervisor:
         "lost_node"|"hang", exit_code)``."""
         elastic_code = self._elastic_exit_code()
         next_probe = time.monotonic() + self.membership_interval
+        # lint-ok: bounded-retries the watch loop is bounded by the
+        # child's lifetime (poll() returning), not by a deadline
         while True:
             code = child.poll()
             if code is not None:

@@ -27,7 +27,7 @@ class StatRegistry:
     """Named integer counters with peaks (monitor.h:77)."""
 
     def __init__(self):
-        self._stats: dict[str, _Stat] = {}
+        self._stats: dict[str, _Stat] = {}      # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def add(self, name, delta):

@@ -501,16 +501,23 @@ def _load_tool(name):
 
 
 class TestBoundedRetriesLint:
-    def test_repo_has_no_unbounded_retry_loops(self):
-        assert _load_tool("check_bounded_retries").check() == []
+    # the repo-wide sweep now runs ONCE in the consolidated suite:
+    # tests/test_static_analysis.py::TestTier1Suite
 
-    def test_allowlisted_daemons_are_the_only_unbounded_loops(self):
+    def test_sanctioned_daemons_carry_inline_suppressions(self):
+        # the legacy module-level ALLOWLIST is retired: the sanctioned
+        # unbounded loops (supervisor._watch, multiprocess._get) now
+        # carry inline '# lint-ok: bounded-retries <reason>' markers at
+        # the loop itself, so the exemption is visible at the site
+        import os
+
         mod = _load_tool("check_bounded_retries")
-        flagged = mod.check(allowlist=())
-        assert len(flagged) == len(mod.ALLOWLIST)
-        blob = "\n".join(flagged)
-        for rel, fn in mod.ALLOWLIST:
-            assert rel in blob and f"in {fn}()" in blob
+        assert mod.ALLOWLIST == set()
+        root = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "paddle_tpu")
+        for rel in ("resilience/supervisor.py", "io/multiprocess.py"):
+            with open(os.path.join(root, rel)) as f:
+                assert "lint-ok: bounded-retries" in f.read(), rel
 
     def test_lint_catches_bare_retry_loop(self, tmp_path):
         mod = _load_tool("check_bounded_retries")

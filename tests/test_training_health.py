@@ -703,9 +703,8 @@ class TestUnitSuffixLint:
         spec.loader.exec_module(mod)
         return mod
 
-    def test_repo_is_clean(self):
-        violations = self._tool().check()
-        assert violations == [], "\n".join(violations)
+    # the repo-wide sweep now runs ONCE in the consolidated suite:
+    # tests/test_static_analysis.py::TestTier1Suite
 
     def test_unit_suffix_rules(self, tmp_path):
         bad = tmp_path / "bad.py"

@@ -1,0 +1,3 @@
+"""Repo tooling: lints, sweeps, profiles.  The static-analysis suite
+lives in :mod:`tools.analysis`; the ``check_*.py`` modules at this
+level are compatibility shims over its passes."""

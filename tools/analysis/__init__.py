@@ -1,0 +1,30 @@
+"""Unified static-analysis framework for the repo's tier-1 lints.
+
+One :class:`~tools.analysis.core.Project` loader + parse cache, one
+:class:`~tools.analysis.core.Finding` record, uniform ``# lint-ok:
+<rule> <reason>`` suppressions and per-rule baseline files, and a
+``python -m tools.analysis`` CLI that runs every registered pass.
+
+Passes (see :mod:`tools.analysis.passes`):
+
+====================== ==============================================
+rule id                invariant
+====================== ==============================================
+atomic-writes          durable writes go through resilience.atomic
+metric-names           Prometheus naming conventions
+fault-sites            every fault site exercised by a test
+collective-instrumented every public collective flight-recorded
+bounded-retries        blocking retry loops carry a bound
+excepts                no silent broad-exception swallows
+lock-discipline        guarded-by attrs accessed under their lock;
+                       no lock-order cycles; no split check-then-act
+trace-purity           jitted call graphs free of clocks/randomness/
+                       host syncs/global mutation
+====================== ==============================================
+"""
+from tools.analysis.core import (Finding, Project, REGISTRY, register,
+                                 run_all, run_pass, load_baseline,
+                                 write_baseline, main)
+
+__all__ = ["Finding", "Project", "REGISTRY", "register", "run_all",
+           "run_pass", "load_baseline", "write_baseline", "main"]

@@ -1,0 +1,15 @@
+import os
+import sys
+
+# direct script execution (`python tools/analysis`) lacks the repo
+# root on sys.path; `python -m tools.analysis` from the repo root is
+# the documented form and already has it
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     os.pardir, os.pardir))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.analysis.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,338 @@
+"""Shared static-analysis core: one loader, one finding record, one
+suppression + baseline scheme for every lint in the repo.
+
+Six generations of one-off ``tools/check_*.py`` scripts each re-walked
+the tree with a private loader, a private finding format and a private
+allowlist dialect.  This module is the consolidation: a
+:class:`Project` loads and parses every file ONCE (all passes share
+the cache), passes return :class:`Finding` records, and the runner
+applies two uniform escape hatches —
+
+- **suppression**: ``# lint-ok: <rule> <reason>`` on the flagged line
+  (or the line directly above, for lines with no room) silences that
+  one finding.  The reason is mandatory; a naked ``lint-ok:`` marker
+  suppresses nothing.
+- **baseline**: ``tools/analysis/baselines/<rule>.txt`` lists
+  grandfathered findings as ``<file>: <message>`` lines (no line
+  numbers — baselines must survive unrelated edits).  ``python -m
+  tools.analysis --write-baseline <rule>`` regenerates one.
+
+``python -m tools.analysis`` runs every registered pass and exits
+nonzero on any finding that is neither suppressed nor baselined.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+import time
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 os.pardir, os.pardir))
+BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baselines")
+
+_LINT_OK = re.compile(r"#\s*lint-ok:\s*(?P<rule>[A-Za-z0-9_-]+)\s+\S")
+
+
+class Finding:
+    """One diagnostic: where (repo-relative file, 1-based line), which
+    rule, and a human message.  ``baseline_key`` intentionally omits
+    the line number so a baseline survives edits elsewhere in the
+    file."""
+
+    __slots__ = ("file", "line", "rule", "message")
+
+    def __init__(self, file, line, rule, message):
+        self.file = file
+        self.line = int(line)
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self):
+        return f"Finding({str(self)!r})"
+
+    def __str__(self):
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def __eq__(self, other):
+        return isinstance(other, Finding) and (
+            (self.file, self.line, self.rule, self.message)
+            == (other.file, other.line, other.rule, other.message))
+
+    def __hash__(self):
+        return hash((self.file, self.line, self.rule, self.message))
+
+    @property
+    def baseline_key(self):
+        return f"{self.file}: {self.message}"
+
+
+class SourceModule:
+    """One parsed file: raw text, split lines and a lazily-built AST,
+    cached so eight passes cost one parse."""
+
+    def __init__(self, path, rel):
+        self.path = path
+        self.rel = rel                      # repo-relative, posix slashes
+        with open(path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree = None
+        self._parse_error = None
+
+    @property
+    def tree(self):
+        """The module AST, or ``None`` on a syntax error (passes skip
+        unparseable files; the file would fail import long before any
+        lint matters)."""
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=self.path)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    def line_at(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, rule, lineno):
+        """True when the finding line — or the contiguous block of
+        comment-only lines directly above it — carries
+        ``# lint-ok: <rule> <reason>``."""
+        def matches(text):
+            m = _LINT_OK.search(text)
+            return bool(m and m.group("rule") in (rule, "all"))
+
+        if matches(self.line_at(lineno)):
+            return True
+        ln = lineno - 1
+        while ln >= 1 and self.line_at(ln).strip().startswith("#"):
+            if matches(self.line_at(ln)):
+                return True
+            ln -= 1
+        return False
+
+
+class Project:
+    """The analysis universe: every ``.py`` under ``package_root``
+    (default ``paddle_tpu/``), loaded once, plus the raw text of
+    ``tests/`` for coverage-style passes.  Both roots are overridable
+    so self-tests can point a pass at a fixture tree."""
+
+    def __init__(self, package_root=None, tests_root=None,
+                 repo_root=None):
+        self.repo_root = os.path.abspath(repo_root or REPO_ROOT)
+        self.package_root = os.path.abspath(
+            package_root or os.path.join(self.repo_root, "paddle_tpu"))
+        self.tests_root = os.path.abspath(
+            tests_root or os.path.join(self.repo_root, "tests"))
+        self._modules = None
+        self._tests_blob = None
+
+    def _rel(self, path):
+        # repo-relative when under the repo, package-dir-relative for
+        # fixture trees living in a tmpdir
+        for base in (self.repo_root, os.path.dirname(self.package_root)):
+            if path.startswith(base + os.sep):
+                return os.path.relpath(path, base).replace(os.sep, "/")
+        return os.path.basename(path)
+
+    def modules(self):
+        """All package modules, loaded+cached on first call, sorted by
+        path so every pass sees one deterministic order."""
+        if self._modules is None:
+            found = []
+            for dirpath, _, files in os.walk(self.package_root):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        found.append(SourceModule(full, self._rel(full)))
+            found.sort(key=lambda m: m.rel)
+            self._modules = found
+        return self._modules
+
+    def module(self, rel_suffix):
+        """The first module whose repo-relative path ends with
+        ``rel_suffix`` (e.g. ``distributed/collective.py``), or None."""
+        for mod in self.modules():
+            if mod.rel.endswith(rel_suffix):
+                return mod
+        return None
+
+    def tests_blob(self):
+        """All test sources concatenated — coverage passes only need
+        'does this literal appear anywhere under tests/'."""
+        if self._tests_blob is None:
+            chunks = []
+            if os.path.isdir(self.tests_root):
+                for dirpath, _, files in os.walk(self.tests_root):
+                    for name in sorted(files):
+                        if name.endswith(".py"):
+                            with open(os.path.join(dirpath, name),
+                                      encoding="utf-8") as f:
+                                chunks.append(f.read())
+            self._tests_blob = "\n".join(chunks)
+        return self._tests_blob
+
+
+# --------------------------------------------------------- pass registry
+
+#: rule-id -> pass callable ``(Project) -> [Finding]``; populated by
+#: :func:`register` at import of :mod:`tools.analysis.passes`
+REGISTRY = {}
+
+
+def register(rule, doc=""):
+    """Decorator: install ``fn(project) -> [Finding]`` under ``rule``."""
+    def deco(fn):
+        fn.rule = rule
+        fn.doc = doc or (fn.__doc__ or "").strip().splitlines()[0]
+        REGISTRY[rule] = fn
+        return fn
+    return deco
+
+
+def baseline_path(rule, baseline_dir=None):
+    return os.path.join(baseline_dir or BASELINE_DIR, f"{rule}.txt")
+
+
+def load_baseline(rule, baseline_dir=None):
+    """The grandfathered ``baseline_key`` set for one rule (empty when
+    no baseline file exists — the normal, fully-clean state)."""
+    path = baseline_path(rule, baseline_dir)
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        return {line.strip() for line in f
+                if line.strip() and not line.startswith("#")}
+
+
+def write_baseline(rule, findings, baseline_dir=None):
+    """Regenerate one rule's baseline from its current raw findings.
+    An empty finding list removes the file: no findings, no baseline."""
+    path = baseline_path(rule, baseline_dir)
+    keys = sorted({f.baseline_key for f in findings})
+    if not keys:
+        if os.path.exists(path):
+            os.remove(path)
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    body = ("# grandfathered findings for rule '%s'\n"
+            "# regenerate: python -m tools.analysis --write-baseline %s\n"
+            % (rule, rule)) + "\n".join(keys) + "\n"
+    # plain write is fine here: this file is repo-tracked tool state,
+    # regenerated on demand, not runtime-durable data
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(body)
+    os.replace(tmp, path)
+    return path
+
+
+def apply_suppressions(project, findings):
+    """Drop findings whose line carries a matching ``lint-ok`` marker."""
+    by_rel = {m.rel: m for m in project.modules()}
+    kept = []
+    for f in findings:
+        mod = by_rel.get(f.file)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    return kept
+
+
+def run_pass(fn, project, baseline_dir=None):
+    """One pass end to end: run, suppress, split vs baseline.  Returns
+    ``(new_findings, baselined_findings, elapsed_s)``."""
+    t0 = time.perf_counter()
+    raw = fn(project)
+    kept = apply_suppressions(project, raw)
+    base = load_baseline(fn.rule, baseline_dir)
+    new = [f for f in kept if f.baseline_key not in base]
+    old = [f for f in kept if f.baseline_key in base]
+    return new, old, time.perf_counter() - t0
+
+
+def run_all(project=None, rules=None, baseline_dir=None):
+    """Run every registered pass (or the named subset).  Returns a
+    report dict; ``report['new']`` nonempty means the suite fails."""
+    # ensure the pass modules have registered themselves
+    from tools.analysis import passes as _passes  # noqa: F401
+
+    project = project or Project()
+    selected = rules or list(REGISTRY)
+    report = {"passes": {}, "new": [], "baselined": [],
+              "files_scanned": len(project.modules())}
+    t0 = time.perf_counter()
+    for rule in selected:
+        fn = REGISTRY[rule]
+        new, old, dt = run_pass(fn, project, baseline_dir)
+        report["passes"][rule] = {
+            "new": len(new), "baselined": len(old), "seconds": dt}
+        report["new"].extend(new)
+        report["baselined"].extend(old)
+    report["seconds"] = time.perf_counter() - t0
+    return report
+
+
+def main(argv=None):
+    import argparse
+
+    from tools.analysis import passes as _passes  # noqa: F401
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="run the repo's static-analysis suite")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="RULE", choices=sorted(REGISTRY),
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--root", default=None,
+                    help="package root to scan (default: paddle_tpu/)")
+    ap.add_argument("--write-baseline", action="append", default=None,
+                    metavar="RULE",
+                    help="regenerate the baseline for RULE from current "
+                         "findings, then exit 0")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for rule in sorted(REGISTRY):
+            print(f"{rule:26s} {REGISTRY[rule].doc}")
+        return 0
+
+    project = Project(package_root=args.root)
+
+    if args.write_baseline:
+        for rule in args.write_baseline:
+            fn = REGISTRY[rule]
+            raw = apply_suppressions(project, fn(project))
+            path = write_baseline(rule, raw)
+            print(f"[{rule}] baseline: {len(raw)} finding(s) -> {path}")
+        return 0
+
+    report = run_all(project, rules=args.rule)
+    for f in report["baselined"] if args.show_baselined else []:
+        print(f"BASELINED {f}")
+    for f in report["new"]:
+        print(f"{f}", file=sys.stderr)
+    n_pass = len(report["passes"])
+    if report["new"]:
+        print(f"tools.analysis: {len(report['new'])} new finding(s) "
+              f"across {n_pass} passes "
+              f"({report['files_scanned']} files, "
+              f"{report['seconds']:.2f}s)", file=sys.stderr)
+        return 1
+    extra = (f", {len(report['baselined'])} baselined"
+             if report["baselined"] else "")
+    print(f"tools.analysis: OK — {n_pass} passes, "
+          f"{report['files_scanned']} files, 0 new findings{extra} "
+          f"({report['seconds']:.2f}s)")
+    return 0

@@ -1,0 +1,17 @@
+"""Pass modules; importing this package registers every pass with
+:data:`tools.analysis.core.REGISTRY`.  Order here is execution order:
+cheap regex passes first, the two AST-heavy flagship passes last."""
+from tools.analysis.passes import (  # noqa: F401
+    atomic_writes,
+    metric_names,
+    fault_sites,
+    collective_instrumented,
+    bounded_retries,
+    excepts,
+    lock_discipline,
+    trace_purity,
+)
+
+__all__ = ["atomic_writes", "metric_names", "fault_sites",
+           "collective_instrumented", "bounded_retries", "excepts",
+           "lock_discipline", "trace_purity"]

@@ -1,0 +1,74 @@
+"""Rule ``atomic-writes``: durable writes under paddle_tpu/ must go
+through the resilience layer's tmp+rename helpers.
+
+A file opened for write (``'w'``/``'wb'``/``'x'``/``'a'``/...)
+anywhere else is a torn-file hazard: a crash mid-write corrupts
+whatever used to be at that path.  ``resilience.atomic.atomic_write``
+owns the tmp+``os.replace`` commit; the handful of sanctioned direct
+writers (trace/log artifacts whose loss is cosmetic) carry inline
+``# lint-ok: atomic-writes <reason>`` comments — the file-level
+allowlist the old one-off lint kept is gone.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from tools.analysis.core import (Finding, Project, apply_suppressions,
+                                 register)
+
+# open(path, "w"/"wb"/"a"/"x"/... ) with the mode as a positional or
+# mode= literal; tolerates whitespace and f-string paths on one line
+_OPEN_WRITE = re.compile(
+    r"""\bopen\s*\(              # open(
+        [^()]*?,                 #   first arg (no nested parens)
+        \s*(?:mode\s*=\s*)?      #   optional mode=
+        (['"])([wax]b?\+?t?)\1   #   'w' 'wb' 'a' 'ab' 'x' ...
+    """, re.VERBOSE)
+
+RULE = "atomic-writes"
+
+
+@register(RULE, "durable writes go through resilience.atomic")
+def find(project):
+    out = []
+    for mod in project.modules():
+        for lineno, line in enumerate(mod.lines, 1):
+            code = line.split("#", 1)[0]
+            if _OPEN_WRITE.search(code):
+                out.append(Finding(
+                    mod.rel, lineno, RULE,
+                    f"non-atomic file write: {line.strip()} — use "
+                    f"paddle_tpu.resilience.atomic.atomic_write"))
+    return out
+
+
+# ------------------------------------------------- legacy shim surface
+
+def check(root=None):
+    """Old-format violations list: ``['paddle_tpu/<rel>:<line>: <src>']``
+    (kept for the ``tools/check_atomic_writes.py`` shim)."""
+    project = Project(package_root=root) if root else Project()
+    by_rel = {m.rel: m for m in project.modules()}
+    out = []
+    for f in apply_suppressions(project, find(project)):
+        mod = by_rel[f.file]
+        rel = os.path.relpath(mod.path,
+                              project.package_root).replace(os.sep, "/")
+        out.append(f"paddle_tpu/{rel}:{f.line}: "
+                   f"{mod.line_at(f.line).strip()}")
+    return out
+
+
+def main(argv=None):
+    violations = check(argv[0] if argv else None)
+    if violations:
+        print("non-atomic file writes (use "
+              "paddle_tpu.resilience.atomic.atomic_write):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("check_atomic_writes: OK")
+    return 0

@@ -1,0 +1,155 @@
+"""Rule ``bounded-retries``: retry/poll loops under paddle_tpu/ must
+bound themselves.
+
+A ``while True`` that sleeps-and-retries around a network / store /
+engine call turns one dead peer into a wedged process.  The contract
+(``resilience/retry.py``) is that every such loop is bounded by a
+:class:`Deadline` or an attempt budget — flagged here when the body
+contains a *blocking edge* (``sleep``, ``recv``/``connect``/``poll``,
+a ``timeout=`` call, ``next(<backoff>)``) and no bound reference.
+The sanctioned unbounded daemons (supervisor child watch, dataloader
+worker poll) carry ``# lint-ok: bounded-retries <reason>`` comments at
+the loop header instead of the old module allowlist.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from tools.analysis.core import Finding, Project, register
+
+_BLOCKING_NAMES = {"recv", "recv_into", "accept", "connect", "poll",
+                   "serve_forever", "urlopen"}
+_BOUND_IDS = {"deadline", "dl", "max_attempts", "attempt", "attempts",
+              "retries"}
+_BOUND_ATTRS = {"remaining", "expired"}
+
+RULE = "bounded-retries"
+
+
+def _call_name(node):
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_blocking(loop):
+    """Does the loop body contain a blocking-edge call?"""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "sleep" or name in _BLOCKING_NAMES:
+            return True
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return True
+        if name == "next" and node.args:
+            arg = node.args[0]
+            arg_name = (arg.id if isinstance(arg, ast.Name) else
+                        arg.attr if isinstance(arg, ast.Attribute) else "")
+            if "delay" in arg_name.lower() or "backoff" in arg_name.lower():
+                return True
+    return False
+
+
+def _is_bounded(loop):
+    """Does the loop reference a Deadline / attempt budget?"""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Name):
+            ident = node.id.lower()
+            if node.id == "Deadline" or ident in _BOUND_IDS \
+                    or "deadline" in ident:
+                return True
+        elif isinstance(node, ast.Attribute):
+            attr = node.attr.lower()
+            if attr in _BOUND_ATTRS or attr in _BOUND_IDS \
+                    or "deadline" in attr:
+                return True
+    return False
+
+
+def _is_forever(test):
+    """``while True:`` / ``while 1:`` — a constant-true test."""
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _find_raw(project):
+    """[(Finding, fn_name)] before allowlist/suppression filtering."""
+    out = []
+    for mod in project.modules():
+        tree = mod.tree
+        if tree is None:
+            continue
+        # map each while-loop to its innermost enclosing function
+        func_of = {}
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.While):
+                        func_of[node] = fn.name   # innermost wins (later)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While) or \
+                    not _is_forever(node.test):
+                continue
+            if not _is_blocking(node) or _is_bounded(node):
+                continue
+            fn_name = func_of.get(node, "<module>")
+            out.append((Finding(
+                mod.rel, node.lineno, RULE,
+                f"unbounded 'while True' around a blocking call in "
+                f"{fn_name}() — bound it with resilience.retry "
+                f"(max_attempts) or a Deadline, or suppress a genuine "
+                f"daemon with '# lint-ok: {RULE} <reason>'"), fn_name))
+    return out
+
+
+@register(RULE, "blocking retry loops carry a Deadline/attempt bound")
+def find(project):
+    return [f for f, _ in _find_raw(project)]
+
+
+# ------------------------------------------------- legacy shim surface
+
+#: the old module-level allowlist is empty — the sanctioned daemons now
+#: carry inline ``lint-ok`` comments; kept so shim importers still find
+#: the name
+ALLOWLIST = set()
+
+
+def check(root=None, allowlist=None):
+    """Old-format list, paths relative to ``root``:
+    ``['<rel>:<line> in <fn>(): unbounded ...']``."""
+    project = Project(package_root=root) if root else Project()
+    allow = ALLOWLIST if allowlist is None else set(allowlist)
+    by_rel = {m.rel: m for m in project.modules()}
+    out = []
+    for f, fn_name in _find_raw(project):
+        mod = by_rel[f.file]
+        if mod.suppressed(RULE, f.line):
+            continue
+        rel = os.path.relpath(mod.path,
+                              project.package_root).replace(os.sep, "/")
+        if (rel, fn_name) in allow:
+            continue
+        out.append(
+            f"{rel}:{f.line} in {fn_name}(): unbounded "
+            f"'while True' around a blocking call — bound it with "
+            f"resilience.retry (max_attempts) or a Deadline, or "
+            f"allowlist a genuine daemon")
+    return sorted(out)
+
+
+def main(argv=None):
+    violations = check()
+    if violations:
+        print("unbounded retry/poll loops (see tools/"
+              "check_bounded_retries.py):", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("check_bounded_retries: OK")
+    return 0

@@ -1,0 +1,107 @@
+"""Rule ``collective-instrumented``: every public op in
+``distributed/collective.py`` must route through the distributed
+flight recorder.
+
+Reads the module's ``__all__`` literal and requires each exported
+module-level function (group factories ``new_group``/``get_group``
+exempt, classes skipped naturally) to carry the
+``@record_collective("<op>")`` decorator from
+:mod:`paddle_tpu.observability.flight`.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+
+from tools.analysis.core import (Finding, Project, SourceModule,
+                                 apply_suppressions, register)
+
+#: exported names that are op *plumbing*, not collectives
+EXEMPT = {"new_group", "get_group"}
+
+RULE = "collective-instrumented"
+
+
+def _exported_names(tree):
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                return {elt.value for elt in node.value.elts
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)}
+    return set()
+
+
+def _decorator_name(dec):
+    f = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _instrumented(fn):
+    return any(_decorator_name(d) == "record_collective"
+               for d in fn.decorator_list)
+
+
+def _find_in_module(mod):
+    tree = mod.tree
+    if tree is None:
+        return []
+    exported = _exported_names(tree)
+    out = []
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name not in exported or node.name in EXEMPT:
+            continue
+        if not _instrumented(node):
+            out.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"public collective op {node.name!r} not routed "
+                f"through the flight recorder — add "
+                f'@record_collective("{node.name}")'))
+    return out
+
+
+@register(RULE, "every public collective op flight-recorded")
+def find(project):
+    mod = project.module("distributed/collective.py")
+    return _find_in_module(mod) if mod is not None else []
+
+
+# ------------------------------------------------- legacy shim surface
+
+def check(path=None):
+    """Old-format list ``['op (path:line): problem']``."""
+    if path is None:
+        project = Project()
+        findings = apply_suppressions(project, find(project))
+    else:
+        mod = SourceModule(path, path.rsplit("/", 1)[-1])
+        findings = [f for f in _find_in_module(mod)
+                    if not mod.suppressed(RULE, f.line)]
+    out = []
+    for f in findings:
+        op = f.message.split("'")[1]
+        out.append(f"{op} ({f.file}:{f.line}): public collective op "
+                   f"not routed through the flight recorder — add "
+                   f'@record_collective("{op}")')
+    return out
+
+
+def main(argv=None):
+    uncovered = check(argv[0] if argv else None)
+    if uncovered:
+        print("silently untraced collectives "
+              "(see tools/check_collective_instrumented.py):",
+              file=sys.stderr)
+        for u in uncovered:
+            print(f"  {u}", file=sys.stderr)
+        return 1
+    print("check_collective_instrumented: OK")
+    return 0

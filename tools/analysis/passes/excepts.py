@@ -1,0 +1,111 @@
+"""Rule ``excepts``: no silent broad-exception swallows under
+paddle_tpu/.
+
+Flags any handler that catches **broadly** (bare ``except:``,
+``Exception`` or ``BaseException``, alone or in a tuple) and **does
+nothing** (only ``pass``/``continue``/``break``/constants).  A flagged
+handler must log, re-raise, recover with real code, narrow its
+exception list, or carry an explicit reason: either the uniform
+``# lint-ok: excepts <reason>`` on the ``except`` line, or the
+rule-native ``# silent-ok: <reason>`` anywhere on the handler's source
+lines (the form seeded across the package's genuine cleanup paths).
+The reason is mandatory in both spellings.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import sys
+
+from tools.analysis.core import (Finding, Project, apply_suppressions,
+                                 register)
+
+# the reason is mandatory in both spellings: a naked marker is still
+# a violation
+MARKER = re.compile(r"#\s*(?:silent-ok:|lint-ok:\s*excepts\s)\s*\S")
+
+_BROAD = ("Exception", "BaseException")
+
+RULE = "excepts"
+
+
+def _catches_broadly(handler):
+    t = handler.type
+    if t is None:                           # bare except:
+        return True
+
+    def name_of(node):
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    if isinstance(t, ast.Tuple):
+        return any(name_of(e) in _BROAD for e in t.elts)
+    return name_of(t) in _BROAD
+
+
+def _does_nothing(handler):
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue                        # docstring / ellipsis
+        return False
+    return True
+
+
+def _allowlisted(handler, lines):
+    last = max(getattr(s, "end_lineno", s.lineno) for s in handler.body)
+    blob = "\n".join(lines[handler.lineno - 1:last])
+    return bool(MARKER.search(blob))
+
+
+@register(RULE, "no silent broad-exception swallows")
+def find(project):
+    out = []
+    for mod in project.modules():
+        tree = mod.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not (_catches_broadly(node) and _does_nothing(node)):
+                continue
+            if _allowlisted(node, mod.lines):
+                continue
+            what = ("bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}")
+            out.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"{what} swallows silently — log, re-raise, narrow "
+                f"the exception, or add '# silent-ok: <reason>'"))
+    return out
+
+
+# ------------------------------------------------- legacy shim surface
+
+def check(root=None):
+    """Old-format list ``['relpath:lineno: except <what>']``."""
+    project = Project(package_root=root) if root else Project()
+    out = []
+    for f in apply_suppressions(project, find(project)):
+        what = f.message.split(" swallows", 1)[0]
+        out.append(f"{f.file}:{f.line}: {what}")
+    return sorted(out)
+
+
+def main(argv=None):
+    bad = check()
+    if bad:
+        print("silent broad-exception swallows (log, re-raise, narrow "
+              "the exception, or add '# silent-ok: <reason>'):",
+              file=sys.stderr)
+        for b in bad:
+            print(f"  {b}", file=sys.stderr)
+        return 1
+    print("check_excepts: OK (no silent broad swallows)")
+    return 0

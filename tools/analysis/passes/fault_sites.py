@@ -1,0 +1,99 @@
+"""Rule ``fault-sites``: every fault site registered under paddle_tpu/
+must be exercised by at least one test.
+
+Collects every site name declared in the package (positional
+``fault_point("...")`` literals and ``site="..."`` keyword literals)
+and checks that each name appears somewhere under tests/.  Keyword
+*defaults* (like ``atomic_write``'s ``site="io.write"``) declare a
+parameter, not a site, and are skipped.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+
+from tools.analysis.core import (Finding, Project, apply_suppressions,
+                                 register)
+
+RULE = "fault-sites"
+
+
+def _collect(project):
+    """``{site_name: (mod, lineno)}`` for every literal fault site."""
+    sites = {}
+    for mod in project.modules():
+        tree = mod.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if fn_name == "fault_point" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                sites.setdefault(node.args[0].value, (mod, node.lineno))
+            for kw in node.keywords:
+                if kw.arg == "site" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    sites.setdefault(kw.value.value, (mod, node.lineno))
+    return sites
+
+
+@register(RULE, "every fault site exercised by a test")
+def find(project):
+    sites = _collect(project)
+    blob = project.tests_blob()
+    out = []
+    for name, (mod, lineno) in sorted(sites.items()):
+        if name not in blob:
+            out.append(Finding(
+                mod.rel, lineno, RULE,
+                f"fault site {name!r} has no exercising test — add a "
+                f"matrix case (e.g. injected_faults(FaultSpec"
+                f"({name!r}, ...)))"))
+    return out
+
+
+# ------------------------------------------------- legacy shim surface
+
+def collect_sites(root=None):
+    """``{site_name: 'relpath:lineno'}`` — old shim surface."""
+    project = Project(package_root=root) if root else Project()
+    return {name: f"{mod.rel}:{lineno}"
+            for name, (mod, lineno) in _collect(project).items()}
+
+
+def covered_sites(sites, tests_root=None):
+    """The subset of ``sites`` whose name appears in any test file."""
+    project = Project(tests_root=tests_root) if tests_root else Project()
+    blob = project.tests_blob()
+    return {s for s in sites if s in blob}
+
+
+def check(root=None, tests_root=None):
+    """Old-format list ``['site (declared at path:line)']``."""
+    project = Project(package_root=root, tests_root=tests_root)
+    return [f"{_site_of(f.message)} (declared at {f.file}:{f.line})"
+            for f in apply_suppressions(project, find(project))]
+
+
+def _site_of(message):
+    # message leads with "fault site '<name>' has no ..."
+    return message.split("'")[1]
+
+
+def main(argv=None):
+    uncovered = check()
+    if uncovered:
+        print("fault sites with no exercising test (add a matrix case "
+              "in tests/, e.g. injected_faults(FaultSpec(site, ...))):",
+              file=sys.stderr)
+        for u in uncovered:
+            print(f"  {u}", file=sys.stderr)
+        return 1
+    print(f"check_fault_sites: OK ({len(collect_sites())} sites covered)")
+    return 0

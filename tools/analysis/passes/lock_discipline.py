@@ -1,0 +1,539 @@
+"""Rule ``lock-discipline``: statically check the repo's threading
+conventions against declared lock ownership.
+
+Seventeen modules guard shared state by convention only.  This pass
+makes the convention machine-checked via source annotations::
+
+    self._ring = []          # guarded-by: self._lock
+    _REGISTRY = {}           # guarded-by: _LOCK     (module global)
+
+Three finding kinds, all under one rule id:
+
+- **unguarded access** — a read or write of a ``guarded-by`` attribute
+  outside a region holding its declared lock.  Regions are tracked
+  intraprocedurally through the AST: ``with self._lock:`` bodies
+  (including multi-item and aliased ``with``), and explicit
+  ``lock.acquire()`` … ``lock.release()`` spans (the
+  ``try``/``finally`` idiom).
+- **lock-order cycle** — nested lock regions contribute edges to a
+  global (cross-module) acquisition-order graph; any cycle is a
+  deadlock hazard.
+- **split check-then-act** — within one function, an attribute *read
+  in a test position* (an ``if``/``while``/``assert`` condition, a
+  comparison or boolean expression) inside one lock region and
+  *mutated* in a LATER, separate region of the same lock: the check's
+  answer may be stale by the time the mutation runs.
+
+Conventions the pass understands:
+
+- ``__init__`` / ``__del__`` bodies are exempt — the object is not
+  yet (no longer) shared.
+- a method whose name ends in ``_locked`` asserts "caller holds the
+  lock(s)": its body is analyzed with every declared lock held.  The
+  pass cannot verify the *callers* (intraprocedural); the suffix is
+  the documented contract.
+- mutating method calls (``.append``/``.pop``/``.update``/...) and
+  subscript stores on a guarded attribute count as writes.
+- false positives are silenced per line with
+  ``# lint-ok: lock-discipline <reason>``.
+
+Known limits (documented, not fixed): the analysis is per-function, so
+a helper called with the lock held must use the ``_locked`` suffix;
+lock identity is the *declared expression* qualified by module+class,
+so two classes aliasing one lock object are distinct graph nodes.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Finding, register
+
+RULE = "lock-discipline"
+
+_GUARD = re.compile(
+    r"#[^#]*?\bguarded-by:\s*(?P<lock>[A-Za-z_][A-Za-z0-9_.]*)")
+
+#: method names on a guarded container that mutate it
+MUTATORS = {"append", "appendleft", "extend", "insert", "remove", "pop",
+            "popleft", "popitem", "clear", "update", "setdefault", "add",
+            "discard", "sort", "reverse", "write"}
+
+#: methods whose body runs before/after the object is shared
+_EXEMPT_METHODS = {"__init__", "__del__"}
+
+
+def _unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:   # pragma: no cover - malformed fixture nodes
+        return ""
+
+
+def _line_guard(mod, lineno):
+    m = _GUARD.search(mod.line_at(lineno))
+    return m.group("lock") if m else None
+
+
+class _Region:
+    """One contiguous hold of one lock inside one function."""
+
+    __slots__ = ("lock", "lineno", "reads", "writes", "checked")
+
+    def __init__(self, lock, lineno):
+        self.lock = lock
+        self.lineno = lineno
+        self.reads = {}      # attr -> first lineno
+        self.writes = {}
+        self.checked = {}    # attr read in a test position -> lineno
+
+
+def collect_guards(mod):
+    """``(class_guards, global_guards, lock_names)`` for one module.
+
+    ``class_guards``: {class_name: {attr: lock_expr}} from annotated
+    ``self.X = ...`` lines; ``global_guards``: {name: lock_expr} from
+    annotated module-level assignments.  ``lock_names``: every lock
+    expression declared anywhere in the module (with its qualified id).
+    """
+    tree = mod.tree
+    class_guards, global_guards = {}, {}
+    if tree is None:
+        return class_guards, global_guards
+
+    def targets_of(node):
+        if isinstance(node, ast.Assign):
+            return node.targets
+        if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            return [node.target]
+        return []
+
+    for node in tree.body:
+        lock = None
+        for tgt in targets_of(node):
+            if isinstance(tgt, ast.Name):
+                lock = lock or _line_guard(mod, node.lineno)
+                if lock:
+                    global_guards[tgt.id] = lock
+
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guards = {}
+        for node in ast.walk(cls):
+            for tgt in targets_of(node):
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    lock = _line_guard(mod, node.lineno)
+                    if lock:
+                        guards[tgt.attr] = lock
+        if guards:
+            class_guards[cls.name] = guards
+    return class_guards, global_guards
+
+
+class _FunctionAnalyzer:
+    """Walk one function's statements tracking held locks."""
+
+    def __init__(self, mod, fn, attr_guards, name_guards, qualify,
+                 assume_all_held=False, decl_lines=None):
+        self.mod = mod
+        self.fn = fn
+        self.attr_guards = attr_guards      # {attr: lock_expr}
+        self.name_guards = name_guards      # {global/local: lock_expr}
+        self.decl_lines = decl_lines or {}  # name -> its annotated line
+        self.qualify = qualify              # lock_expr -> qualified id
+        self.lock_exprs = set(attr_guards.values()) | \
+            set(name_guards.values())
+        self.held = {}                      # lock_expr -> depth
+        self.order_stack = []               # acquisition order
+        self.active = {}                    # lock_expr -> _Region
+        self.regions = {}                   # lock_expr -> [_Region]
+        self.findings = []
+        self.edges = []                     # (qual_a, qual_b, lineno)
+        if assume_all_held:
+            for lk in self.lock_exprs:
+                self.held[lk] = 1
+                self.active[lk] = _Region(lk, fn.lineno)
+
+    # ---- lock bookkeeping ------------------------------------------------
+    def _enter(self, lock, lineno):
+        self.held[lock] = self.held.get(lock, 0) + 1
+        if self.held[lock] == 1:
+            region = _Region(lock, lineno)
+            self.active[lock] = region
+            self.regions.setdefault(lock, []).append(region)
+            for prior in self.order_stack:
+                if prior != lock:
+                    self.edges.append((self.qualify(prior),
+                                       self.qualify(lock), lineno))
+            self.order_stack.append(lock)
+
+    def _exit(self, lock):
+        depth = self.held.get(lock, 0)
+        if depth <= 1:
+            self.held.pop(lock, None)
+            self.active.pop(lock, None)
+            if lock in self.order_stack:
+                self.order_stack.remove(lock)
+        else:
+            self.held[lock] = depth - 1
+
+    def _is_lock_expr(self, node):
+        src = _unparse(node)
+        return src if src in self.lock_exprs else None
+
+    # ---- access recording ------------------------------------------------
+    def _record(self, kind, attr, lock, lineno, store, in_test):
+        if self.decl_lines.get(attr) == lineno:
+            return      # the annotated declaration itself (unshared yet)
+        if self.held.get(lock, 0):
+            region = self.active.get(lock)
+            if region is not None:
+                (region.writes if store else region.reads).setdefault(
+                    attr, lineno)
+                if in_test and not store:
+                    region.checked.setdefault(attr, lineno)
+            return
+        what = "write to" if store else "read of"
+        self.findings.append(Finding(
+            self.mod.rel, lineno, RULE,
+            f"unguarded {what} {kind} '{attr}' (guarded-by {lock}) in "
+            f"{self.fn.name}() — hold {lock} or suppress with "
+            f"'# lint-ok: {RULE} <reason>'"))
+
+    # ---- expression scan -------------------------------------------------
+    def scan_expr(self, node, store=False, in_test=False):
+        if node is None:
+            return
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and \
+                    node.attr in self.attr_guards:
+                self._record("attribute", f"self.{node.attr}",
+                             self.attr_guards[node.attr], node.lineno,
+                             store, in_test)
+            self.scan_expr(node.value, store=False, in_test=in_test)
+            return
+        if isinstance(node, ast.Name):
+            if node.id in self.name_guards:
+                self._record("global", node.id,
+                             self.name_guards[node.id], node.lineno,
+                             store, in_test)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                mutates = fn.attr in MUTATORS
+                self.scan_expr(fn.value, store=mutates, in_test=in_test)
+            else:
+                self.scan_expr(fn, in_test=in_test)
+            for a in node.args:
+                self.scan_expr(a, in_test=in_test)
+            for kw in node.keywords:
+                self.scan_expr(kw.value, in_test=in_test)
+            return
+        if isinstance(node, ast.Subscript):
+            target_store = store or isinstance(node.ctx,
+                                               (ast.Store, ast.Del))
+            self.scan_expr(node.value, store=target_store,
+                           in_test=in_test)
+            self.scan_expr(node.slice, in_test=in_test)
+            return
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            for child in ast.iter_child_nodes(node):
+                self.scan_expr(child, in_test=True)
+            return
+        if isinstance(node, ast.IfExp):
+            self.scan_expr(node.test, in_test=True)
+            self.scan_expr(node.body, in_test=in_test)
+            self.scan_expr(node.orelse, in_test=in_test)
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return      # separate scope: analyzed as its own function
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword,
+                                  ast.comprehension)):
+                self.scan_expr(child, store=False, in_test=in_test)
+            elif isinstance(child, ast.expr_context) or \
+                    isinstance(child, (ast.operator, ast.cmpop,
+                                       ast.boolop, ast.unaryop)):
+                continue
+            else:
+                self.scan_expr(child, store=False, in_test=in_test)
+
+    # ---- statement walk --------------------------------------------------
+    def run(self):
+        self.visit_block(self.fn.body)
+        return self
+
+    def visit_block(self, stmts):
+        acquired_here = []
+        for stmt in stmts:
+            acquired_here.extend(self.visit_stmt(stmt))
+        # a lock .acquire()d in this block and never .release()d stays
+        # held only within the block (e.g. acquire + try/finally whose
+        # finally released it already popped it)
+        for lock in acquired_here:
+            if self.held.get(lock, 0):
+                self._exit(lock)
+
+    def visit_stmt(self, stmt):
+        """Returns locks acquire()d by this statement (still held)."""
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered = []
+            for item in stmt.items:
+                lock = self._is_lock_expr(item.context_expr)
+                if lock is not None:
+                    self._enter(lock, stmt.lineno)
+                    entered.append(lock)
+                else:
+                    self.scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.scan_expr(item.optional_vars, store=True)
+            self.visit_block(stmt.body)
+            for lock in reversed(entered):
+                self._exit(lock)
+            return []
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute):
+                lock = self._is_lock_expr(call.func.value)
+                if lock is not None and call.func.attr == "acquire":
+                    self._enter(lock, stmt.lineno)
+                    return [lock]
+                if lock is not None and call.func.attr == "release":
+                    self._exit(lock)
+                    return []
+            self.scan_expr(stmt.value)
+            return []
+        if isinstance(stmt, ast.If):
+            self.scan_expr(stmt.test, in_test=True)
+            self.visit_block(stmt.body)
+            self.visit_block(stmt.orelse)
+            return []
+        if isinstance(stmt, ast.While):
+            self.scan_expr(stmt.test, in_test=True)
+            self.visit_block(stmt.body)
+            self.visit_block(stmt.orelse)
+            return []
+        if isinstance(stmt, ast.For) or isinstance(stmt, ast.AsyncFor):
+            self.scan_expr(stmt.target, store=True)
+            self.scan_expr(stmt.iter)
+            self.visit_block(stmt.body)
+            self.visit_block(stmt.orelse)
+            return []
+        if isinstance(stmt, ast.Try):
+            self.visit_block(stmt.body)
+            for handler in stmt.handlers:
+                self.visit_block(handler.body)
+            self.visit_block(stmt.orelse)
+            self.visit_block(stmt.finalbody)
+            return []
+        if isinstance(stmt, ast.Assert):
+            self.scan_expr(stmt.test, in_test=True)
+            self.scan_expr(stmt.msg)
+            return []
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self.scan_expr(tgt, store=True)
+            self.scan_expr(stmt.value)
+            return []
+        if isinstance(stmt, ast.AugAssign):
+            # read-modify-write: both an access and a mutation
+            self.scan_expr(stmt.target, store=True)
+            self.scan_expr(stmt.value)
+            return []
+        if isinstance(stmt, ast.AnnAssign):
+            self.scan_expr(stmt.target, store=True)
+            self.scan_expr(stmt.value)
+            return []
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self.scan_expr(tgt, store=True)
+            return []
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return []   # nested scope: analyzed separately
+        if isinstance(stmt, (ast.Return, ast.Expr, ast.Raise)):
+            for child in ast.iter_child_nodes(stmt):
+                self.scan_expr(child)
+            return []
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self.visit_stmt(child)
+            else:
+                self.scan_expr(child)
+        return []
+
+    # ---- post-pass: split check-then-act ---------------------------------
+    def check_then_act(self):
+        out = []
+        for lock, regions in self.regions.items():
+            for i, first in enumerate(regions):
+                for later in regions[i + 1:]:
+                    for attr, check_line in first.checked.items():
+                        if attr in later.writes:
+                            out.append(Finding(
+                                self.mod.rel, later.writes[attr], RULE,
+                                f"split check-then-act on '{attr}' in "
+                                f"{self.fn.name}(): checked under "
+                                f"{lock} at line {check_line} but "
+                                f"mutated in a separate lock region — "
+                                f"the check may be stale; merge the "
+                                f"regions or re-validate before "
+                                f"mutating"))
+        return out
+
+
+def _functions_of(tree):
+    """[(class_name_or_None, fn_node, enclosing_fns)] for every def in
+    the module, attributed to its innermost enclosing class and its
+    chain of lexically enclosing functions (outermost first)."""
+    out = []
+
+    def walk(node, cls, parents):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name, parents)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out.append((cls, child, tuple(parents)))
+                walk(child, cls, parents + [child])
+            else:
+                walk(child, cls, parents)
+
+    walk(tree, None, [])
+    return out
+
+
+def _local_guards(mod, fn):
+    """Annotated ``name = ...  # guarded-by: <lock>`` declarations in
+    ``fn``'s own body (nested defs excluded): {name: (lock, decl_line)}.
+    Closure state shared with worker threads is declared this way
+    (e.g. a results dict guarded by a Condition)."""
+    nested = set()
+    for sub in ast.walk(fn):
+        if sub is not fn and isinstance(sub, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+            nested.update(ast.walk(sub))
+    out = {}
+    for node in ast.walk(fn):
+        if node in nested:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target]
+                   if isinstance(node, (ast.AnnAssign, ast.AugAssign))
+                   else [])
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                lock = _line_guard(mod, node.lineno)
+                if lock:
+                    out[tgt.id] = (lock, node.lineno)
+    return out
+
+
+def _find_cycles(edges):
+    """Minimal cycle listing over the acquisition-order digraph."""
+    graph = {}
+    for a, b, _ in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles, seen_cycles = [], set()
+
+    def dfs(node, stack, on_stack):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+            elif (node, nxt) not in visited_edges:
+                visited_edges.add((node, nxt))
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+    visited_edges = set()
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return cycles
+
+
+def analyze_module(mod, global_edges):
+    """All lock-discipline findings for one module; nested-lock edges
+    are appended to ``global_edges`` for the cross-module graph."""
+    tree = mod.tree
+    if tree is None:
+        return []
+    class_guards, global_guards = collect_guards(mod)
+    functions = _functions_of(tree)
+    locals_of = {fn: _local_guards(mod, fn) for _, fn, _ in functions}
+    if not class_guards and not global_guards and \
+            not any(locals_of.values()):
+        return []
+    findings = []
+    for cls_name, fn, parents in functions:
+        if fn.name in _EXEMPT_METHODS:
+            continue
+        attr_guards = class_guards.get(cls_name, {}) if cls_name else {}
+        # closure state annotated in an enclosing function is shared
+        # with this one; its own declarations are exempt at decl line
+        name_guards = dict(global_guards)
+        decl_lines = {}
+        for enclosing in parents + (fn,):
+            for name, (lock, line) in locals_of.get(enclosing,
+                                                    {}).items():
+                name_guards[name] = lock
+                if enclosing is fn:
+                    decl_lines[name] = line
+        if not attr_guards and not name_guards:
+            continue
+
+        def qualify(lock_expr, _cls=cls_name):
+            if lock_expr.startswith("self."):
+                return f"{mod.rel}::{_cls}.{lock_expr[5:]}"
+            return f"{mod.rel}::{lock_expr}"
+
+        analyzer = _FunctionAnalyzer(
+            mod, fn, attr_guards, name_guards, qualify,
+            assume_all_held=fn.name.endswith("_locked"),
+            decl_lines=decl_lines).run()
+        findings.extend(analyzer.findings)
+        findings.extend(analyzer.check_then_act())
+        global_edges.extend(analyzer.edges)
+    return findings
+
+
+@register(RULE, "guarded-by attrs locked; no lock cycles / split CTA")
+def find(project):
+    findings, edges = [], []
+    for mod in project.modules():
+        findings.extend(analyze_module(mod, edges))
+    for cyc in _find_cycles(edges):
+        # anchor the cycle finding at one contributing edge's site
+        a, b = cyc[0], cyc[1]
+        where = next(((m_a, m_b, ln) for m_a, m_b, ln in edges
+                      if m_a == a and m_b == b), None)
+        rel, lineno = ("", 0)
+        if where is not None:
+            rel = where[0].split("::", 1)[0]
+            lineno = where[2]
+        findings.append(Finding(
+            rel, lineno, RULE,
+            "lock-order cycle (deadlock hazard): " + " -> ".join(cyc)
+            + " — acquire these locks in one global order"))
+    return findings
+
+
+def lock_order_edges(project):
+    """The acquisition-order edge list ``[(from, to, lineno)]`` — bench
+    and tests introspect the graph without re-running the whole pass."""
+    edges = []
+    for mod in project.modules():
+        analyze_module(mod, edges)
+    return edges

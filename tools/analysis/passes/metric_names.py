@@ -1,0 +1,108 @@
+"""Rule ``metric-names``: metric names registered under paddle_tpu/
+must follow Prometheus naming conventions.
+
+Statically scanned rules (literal first-argument names to ``Counter(``
+/ ``Gauge(`` / ``Histogram(`` and ``registry.counter(`` & co.):
+
+- names are ``snake_case`` (``^[a-z][a-z0-9_]*$``);
+- counter names end in ``_total``;
+- a name never appears with two different metric kinds;
+- unit suffixes are canonical (``_seconds``/``_bytes``/``_ratio``; no
+  ``_s``/``_ms``/``_kb``/... abbreviations on gauges or histograms);
+- a histogram name must END in a canonical unit suffix.
+"""
+from __future__ import annotations
+
+import re
+import sys
+
+from tools.analysis.core import (Finding, Project, apply_suppressions,
+                                 register)
+
+# Counter("name"...) / Gauge( / Histogram(  — constructor form — and
+# <registry>.counter("name"...) / .gauge( / .histogram( — get-or-create
+# form.  Only literal names are checkable statically; a variable name
+# is skipped (there are none today — keep it that way).
+_METRIC_CALL = re.compile(
+    r"""(?:\b(?P<cls>Counter|Gauge|Histogram)
+         |\.(?P<meth>counter|gauge|histogram))
+        \s*\(\s*(?P<q>['"])(?P<name>[^'"]+)(?P=q)""", re.VERBOSE)
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# canonical unit suffixes for quantity-bearing series
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio")
+# abbreviated / non-canonical unit spellings that MUST NOT end a gauge
+# or histogram name
+_BAD_UNIT = re.compile(
+    r"_(s|sec|secs|ms|millis|micros|us|ns|min|mins|minutes|hr|hrs|"
+    r"hours|kb|mb|gb|tb|kib|mib|gib|pct|percent)$")
+
+RULE = "metric-names"
+
+
+def _stripped_code(mod):
+    """Whole-file text with per-line comments removed — a call split
+    across lines (``Counter(\\n  "name")``) must still be seen."""
+    return "\n".join(line.split("#", 1)[0] for line in mod.lines)
+
+
+@register(RULE, "Prometheus naming conventions on metric literals")
+def find(project):
+    out = []
+    seen = {}                    # name -> (kind, "file:line")
+    for mod in project.modules():
+        code = _stripped_code(mod)
+        for m in _METRIC_CALL.finditer(code):
+            kind = (m.group("cls") or m.group("meth")).lower()
+            name = m.group("name")
+            lineno = code.count("\n", 0, m.start()) + 1
+
+            def f(msg, _l=lineno, _m=mod):
+                out.append(Finding(_m.rel, _l, RULE, msg))
+
+            if not _SNAKE.match(name):
+                f(f"metric name {name!r} is not snake_case")
+            if kind == "counter" and not name.endswith("_total"):
+                f(f"counter {name!r} must end in '_total' "
+                  f"(Prometheus convention)")
+            if kind in ("gauge", "histogram"):
+                m_bad = _BAD_UNIT.search(name)
+                if m_bad:
+                    f(f"{kind} {name!r} uses the non-canonical unit "
+                      f"suffix '_{m_bad.group(1)}' — spell it out "
+                      f"({'/'.join(_UNIT_SUFFIXES)})")
+                elif kind == "histogram" and \
+                        not name.endswith(_UNIT_SUFFIXES):
+                    f(f"histogram {name!r} must end in a canonical "
+                      f"unit suffix ({'/'.join(_UNIT_SUFFIXES)})")
+            prev = seen.get(name)
+            if prev is not None and prev[0] != kind:
+                f(f"{name!r} registered as {kind} but as {prev[0]} "
+                  f"at {prev[1]} — one name, one type")
+            else:
+                seen.setdefault(name, (kind, f"{mod.rel}:{lineno}"))
+    return out
+
+
+# ------------------------------------------------- legacy shim surface
+
+def check(root=None):
+    """Old-format list ``['paddle_tpu/<rel>:<line>: <problem>']``."""
+    project = Project(package_root=root) if root else Project()
+    return [f"{f.file if f.file.startswith('paddle_tpu/') else 'paddle_tpu/' + f.file.split('/', 1)[-1]}"
+            f":{f.line}: {f.message}"
+            for f in apply_suppressions(project, find(project))]
+
+
+def main(argv=None):
+    violations = check(argv[0] if argv else None)
+    if violations:
+        print("metric naming violations "
+              "(Prometheus conventions, see tools/check_metric_names.py):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("check_metric_names: OK")
+    return 0

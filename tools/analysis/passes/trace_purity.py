@@ -1,0 +1,475 @@
+"""Rule ``trace-purity``: functions reached from a ``jax.jit`` entry
+point must be trace-pure.
+
+``jax.jit`` executes the Python body ONCE per input signature; any
+host-side effect inside it silently becomes a per-compile (not
+per-call) event, and any host sync forces a device round-trip on every
+trace.  This pass is the static complement to the runtime compile
+watchdog (PR 2) and the invariant PR 10's step replay depends on
+(replayed steps must re-trace to bitwise-identical programs).
+
+Entry points: a function literally passed to ``jax.jit(...)`` /
+``jit(...)`` (positionally or via ``functools.partial``), or decorated
+``@jax.jit`` / ``@partial(jax.jit, ...)``.  The watched-jit idiom
+``watch(jax.jit(fn))`` resolves through the inner ``jax.jit`` call.
+From each entry the call graph is resolved *within paddle_tpu/*:
+lexically enclosing scopes (the entries are mostly closures), module
+functions, ``self.method()``, and ``from``-imports between package
+modules.  jax/numpy internals are not analyzed.
+
+Flagged inside reached functions:
+
+- **wall-clock reads**: ``time.time/monotonic/perf_counter/...``,
+  ``datetime.now`` — a traced timestamp is frozen at compile time;
+- **host randomness / global state**: ``random.*``, ``np.random.*``
+  (use ``jax.random`` with explicit keys), ``os.environ`` /
+  ``os.getenv`` reads;
+- **host-sync forcers**: ``.item()`` / ``.tolist()``, ``np.asarray`` /
+  ``np.array`` on non-constants, ``float()/int()/bool()`` on traced
+  values (shape/ndim/len reads are static and exempt);
+- **mutation of nonlocal Python state**: ``global``/``nonlocal``
+  declarations, attribute stores (``obj.attr = v``) — the mutation
+  happens per-trace, not per-call;
+- ``print(...)`` — a compile-time-only side effect that looks like a
+  runtime one.
+
+Suppress a vetted site with ``# lint-ok: trace-purity <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Finding, register
+
+RULE = "trace-purity"
+
+_CLOCK_CALLS = {("time", "time"), ("time", "monotonic"),
+                ("time", "perf_counter"), ("time", "process_time"),
+                ("time", "time_ns"), ("time", "monotonic_ns"),
+                ("time", "perf_counter_ns"),
+                ("datetime", "now"), ("datetime", "utcnow")}
+
+_SYNC_ATTRS = {"item", "tolist"}
+_NP_SYNC = {"asarray", "array"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+class _Scope:
+    """One lexical scope (module / class / function) with its local
+    defs, so ``jax.jit(step)`` can resolve ``step`` outward through
+    enclosing functions."""
+
+    def __init__(self, node, parent, cls=None):
+        self.node = node
+        self.parent = parent
+        self.cls = cls                    # innermost enclosing ClassDef
+        self.defs = {}                    # name -> _FuncInfo
+
+
+class _FuncInfo:
+    def __init__(self, mod, node, scope, cls):
+        self.mod = mod
+        self.node = node
+        self.scope = scope                # the scope the def CREATES
+        self.cls = cls                    # class owning it (methods)
+
+    @property
+    def key(self):
+        return (self.mod.rel, self.node.lineno, self.node.name)
+
+
+#: constructors whose module-level result is shared mutable state
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                  "OrderedDict", "Counter"}
+
+
+def _mutable_init(value):
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = _dotted(value.func) or ""
+        return name.rsplit(".", 1)[-1] in _MUTABLE_CTORS
+    return False
+
+
+class _ModuleIndex:
+    """Defs, imports, class methods and mutable globals for one module."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        self.import_alias = {}            # alias -> module name
+        self.from_imports = {}            # name -> (module, original)
+        self.top = _Scope(mod.tree, None)
+        self.methods = {}                 # (class, name) -> _FuncInfo
+        self.functions = []               # every _FuncInfo in the file
+        self.mutable_globals = set()      # module-level dict/list/set names
+        if mod.tree is not None:
+            for node in mod.tree.body:
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target]
+                           if isinstance(node, ast.AnnAssign) else [])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name) and \
+                            _mutable_init(getattr(node, "value", None)):
+                        self.mutable_globals.add(tgt.id)
+            self._index(mod.tree, self.top, cls=None)
+
+    def _index(self, node, scope, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Import):
+                for a in child.names:
+                    self.import_alias[a.asname or
+                                      a.name.split(".")[0]] = a.name
+            elif isinstance(child, ast.ImportFrom):
+                for a in child.names:
+                    self.from_imports[a.asname or a.name] = (
+                        child.module or "", a.name)
+            elif isinstance(child, ast.ClassDef):
+                self._index(child, scope, cls=child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                info = _FuncInfo(self.mod, child,
+                                 _Scope(child, scope, cls), cls)
+                info.scope.defs = {}
+                scope_defs = scope.defs
+                scope_defs[child.name] = info
+                if cls is not None:
+                    self.methods[(cls, child.name)] = info
+                self.functions.append(info)
+                self._index(child, info.scope, cls)
+            else:
+                self._index(child, scope, cls)
+
+
+def _dotted(node):
+    """'a.b.c' for an attribute chain of Names, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_callee(node, index):
+    """Does this Call's func denote jax.jit (directly or aliased)?"""
+    name = _dotted(node.func)
+    if name is None:
+        return False
+    if name in ("jax.jit", "jit"):
+        # `jit` must actually come from jax for the bare spelling
+        if name == "jit":
+            src = index.from_imports.get("jit")
+            return bool(src and src[0].startswith("jax"))
+        return True
+    # alias: `import jax as j` -> j.jit
+    head, _, tail = name.partition(".")
+    return tail == "jit" and index.import_alias.get(head) == "jax"
+
+
+def _jit_fn_args(call):
+    """Candidate function expressions passed to one jax.jit call —
+    unwraps ``functools.partial(fn, ...)``."""
+    args = list(call.args) + [kw.value for kw in call.keywords
+                              if kw.arg in ("fun", "fn")]
+    out = []
+    for a in args[:1]:
+        if isinstance(a, ast.Call) and \
+                (_dotted(a.func) or "").endswith("partial") and a.args:
+            out.append(a.args[0])
+        else:
+            out.append(a)
+    return out
+
+
+def _resolve_name(name, scope):
+    """Look ``name`` up through lexically enclosing scopes."""
+    while scope is not None:
+        if name in scope.defs:
+            return scope.defs[name]
+        scope = scope.parent
+    return None
+
+
+class _Graph:
+    """Cross-module resolution helpers."""
+
+    def __init__(self, project):
+        self.project = project
+        self.indexes = {}
+        for mod in project.modules():
+            if mod.tree is not None:
+                self.indexes[mod.rel] = _ModuleIndex(mod)
+        # module name ("paddle_tpu.observability.metrics") -> index
+        self.by_modname = {}
+        for rel, idx in self.indexes.items():
+            name = rel[:-3].replace("/", ".")
+            if name.endswith(".__init__"):
+                name = name[:-len(".__init__")]
+            self.by_modname[name] = idx
+
+    def resolve_import(self, index, name):
+        """A from-import of ``name`` that lands on a def in another
+        package module."""
+        src = index.from_imports.get(name)
+        if not src:
+            return None
+        module, orig = src
+        # relative imports: fall back to suffix match on module name
+        candidates = [module, f"paddle_tpu.{module}"] if module else []
+        for cand in candidates:
+            idx = self.by_modname.get(cand)
+            if idx and orig in idx.top.defs:
+                return idx.top.defs[orig]
+        if module:
+            for modname, idx in self.by_modname.items():
+                if modname.endswith(module) and orig in idx.top.defs:
+                    return idx.top.defs[orig]
+        return None
+
+    def resolve_call(self, call, info):
+        """Best-effort: the _FuncInfo a Call lands on, or None."""
+        index = self.indexes[info.mod.rel]
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            target = _resolve_name(fn.id, info.scope)
+            if target is not None:
+                return target
+            return self.resolve_import(index, fn.id)
+        if isinstance(fn, ast.Attribute):
+            # self.method()
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and info.cls is not None:
+                return index.methods.get((info.cls, fn.attr))
+            # module.func() through an import alias
+            dotted = _dotted(fn)
+            if dotted:
+                head, _, tail = dotted.rpartition(".")
+                alias_target = index.import_alias.get(head)
+                idx = self.by_modname.get(alias_target or head)
+                if idx and tail in idx.top.defs:
+                    return idx.top.defs[tail]
+        return None
+
+    def resolve_fn_expr(self, expr, info_or_index, scope):
+        """The _FuncInfo a function-valued expression denotes."""
+        index = (info_or_index if isinstance(info_or_index, _ModuleIndex)
+                 else self.indexes[info_or_index.mod.rel])
+        if isinstance(expr, ast.Name):
+            target = _resolve_name(expr.id, scope)
+            if target is not None:
+                return target
+            return self.resolve_import(index, expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            if expr.value.id == "self":
+                for (cls, name), m in index.methods.items():
+                    if name == expr.attr:
+                        return m
+        return None
+
+
+def _entry_points(graph):
+    """Every _FuncInfo passed to / decorated with jax.jit."""
+    entries = []
+    for rel, index in graph.indexes.items():
+        if index.mod.tree is None:
+            continue
+        # decorator form
+        for info in index.functions:
+            for dec in info.node.decorator_list:
+                name = _dotted(dec.func if isinstance(dec, ast.Call)
+                               else dec) or ""
+                is_jit = name == "jax.jit" or (
+                    name == "jit"
+                    and (index.from_imports.get("jit") or ("",))[0]
+                    .startswith("jax"))
+                is_partial_jit = (
+                    isinstance(dec, ast.Call)
+                    and name.endswith("partial") and dec.args
+                    and (_dotted(dec.args[0]) or "") in
+                    ("jax.jit", "jit"))
+                if is_jit or is_partial_jit:
+                    entries.append(info)
+        # call form: jax.jit(fn) anywhere, resolved in its scope
+        scope_of = {}
+
+        def map_scopes(node, scope):
+            for child in ast.iter_child_nodes(node):
+                created = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    for f in index.functions:
+                        if f.node is child:
+                            created = f.scope
+                            break
+                scope_of[child] = scope
+                map_scopes(child, created)
+
+        map_scopes(index.mod.tree, index.top)
+        for node, scope in scope_of.items():
+            if isinstance(node, ast.Call) and \
+                    _is_jit_callee(node, index):
+                for fexpr in _jit_fn_args(node):
+                    target = graph.resolve_fn_expr(fexpr, index, scope)
+                    if target is not None:
+                        entries.append(target)
+    return entries
+
+
+def _reachable(graph, entries):
+    seen, queue = {}, list(entries)
+    for e in entries:
+        seen[e.key] = e
+    while queue:
+        info = queue.pop()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                target = graph.resolve_call(node, info)
+                if target is not None and target.key not in seen:
+                    seen[target.key] = target
+                    queue.append(target)
+    return list(seen.values())
+
+
+def _impurities(info, index):
+    """Findings for one reached function (its own body only — nested
+    defs are separate graph nodes)."""
+    out = []
+    mod = info.mod
+    # nodes belonging to defs/lambdas nested inside this function —
+    # they are separate call-graph nodes, analyzed only if reached
+    nested = set()
+    for sub in ast.walk(info.node):
+        if sub is info.node:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            nested.update(ast.walk(sub))
+
+    def flag(node, msg):
+        out.append(Finding(
+            mod.rel, node.lineno, RULE,
+            f"{msg} inside jitted call graph "
+            f"(reached via {info.node.name}())"))
+
+    # names bound locally (params, assignments) shadow module globals
+    local_names = {a.arg for a in info.node.args.args}
+    local_names.update(a.arg for a in info.node.args.kwonlyargs)
+    for extra in (info.node.args.vararg, info.node.args.kwarg):
+        if extra is not None:
+            local_names.add(extra.arg)
+    for node in ast.walk(info.node):
+        if node in nested:
+            continue
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Store):
+            local_names.add(node.id)
+
+    for node in ast.walk(info.node):
+        if node in nested:
+            continue
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and \
+                node.id in index.mutable_globals and \
+                node.id not in local_names:
+            flag(node, f"module-global mutable state '{node.id}' read "
+                       f"at trace time (value is frozen into the "
+                       f"compiled program)")
+        if isinstance(node, ast.Global):
+            flag(node, "'global' mutation of module state")
+        elif isinstance(node, ast.Nonlocal):
+            flag(node, "'nonlocal' mutation of enclosing state")
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            # self.x = ... on a traced path mutates per-trace
+            flag(node, f"attribute store '{_dotted(node) or node.attr}"
+                       f" = ...' mutates Python object state")
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name:
+                head, _, tail = name.rpartition(".")
+                pair = (head.rsplit(".", 1)[-1], tail)
+                if pair in _CLOCK_CALLS:
+                    flag(node, f"wall-clock read '{name}()'")
+                    continue
+                if head in ("random", "np.random", "numpy.random"):
+                    flag(node, f"host randomness '{name}()' (use "
+                               f"jax.random with an explicit key)")
+                    continue
+                if name in ("os.getenv",):
+                    flag(node, f"environment read '{name}()'")
+                    continue
+                if pair[1] in _NP_SYNC and pair[0] in ("np", "numpy",
+                                                       "onp"):
+                    if not _static_arg(node):
+                        flag(node, f"'{name}(...)' forces a host sync "
+                                   f"on traced values")
+                    continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_ATTRS and not node.args:
+                flag(node, f"'.{node.func.attr}()' forces a host sync")
+                continue
+            if isinstance(node.func, ast.Name):
+                if node.func.id in ("float", "int", "bool") and \
+                        node.args and not _static_arg(node):
+                    flag(node, f"'{node.func.id}(...)' on a traced "
+                               f"value forces a host sync")
+                elif node.func.id == "print":
+                    flag(node, "'print(...)' runs at trace time only")
+        elif isinstance(node, ast.Subscript) or isinstance(node,
+                                                           ast.Attribute):
+            dotted = _dotted(node)
+            if dotted == "os.environ":
+                flag(node, "'os.environ' read")
+    return out
+
+
+def _static_arg(call):
+    """True when the call's first arg is statically known (constant,
+    len(), .shape/.ndim/... read) — not a traced-value sync."""
+    if not call.args:
+        return True
+    a = call.args[0]
+    if isinstance(a, ast.Constant):
+        return True
+    if isinstance(a, ast.Call):
+        inner = _dotted(a.func)
+        if inner == "len":
+            return True
+    if isinstance(a, ast.Attribute) and a.attr in _STATIC_ATTRS:
+        return True
+    if isinstance(a, ast.Subscript) and \
+            isinstance(a.value, ast.Attribute) and \
+            a.value.attr in _STATIC_ATTRS:
+        return True
+    return False
+
+
+@register(RULE, "jitted call graphs free of clocks/randomness/syncs")
+def find(project):
+    graph = _Graph(project)
+    entries = _entry_points(graph)
+    reached = _reachable(graph, entries)
+    out = []
+    seen = set()
+    for info in reached:
+        index = graph.indexes[info.mod.rel]
+        for f in _impurities(info, index):
+            key = (f.file, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+    out.sort(key=lambda f: (f.file, f.line))
+    return out
+
+
+def traced_functions(project):
+    """['rel::qualname'] of every function the pass considers reached
+    from a jit entry point — tests and bench introspect coverage."""
+    graph = _Graph(project)
+    reached = _reachable(graph, _entry_points(graph))
+    return sorted(f"{i.mod.rel}::{i.node.name}" for i in reached)

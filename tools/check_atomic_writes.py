@@ -1,88 +1,19 @@
 #!/usr/bin/env python
-"""Lint: durable writes under paddle_tpu/ must go through the
-resilience layer's tmp+rename helpers.
-
-A file opened for write ('w'/'wb'/'x'/'a'/...) anywhere else is a torn-
-file hazard: a crash mid-write corrupts whatever used to be at that
-path.  ``paddle_tpu.resilience.atomic.atomic_write`` is the one place
-allowed to do it (it owns the tmp+``os.replace`` commit); trace/log
-writers are allowlisted — losing half a trace is annoying, losing half
-a checkpoint is an outage.
-
-Run directly (exit 1 on violations) or import ``check()`` — a tier-1
-test wires it into the suite so a regressing ``open(..., "w")`` fails
-CI, not a postmortem.
-"""
+"""Compatibility shim: the atomic-writes lint now lives in the unified
+static-analysis framework as :mod:`tools.analysis.passes.atomic_writes`
+(rule id ``atomic-writes``).  ``check()``/``main()`` keep their old
+signatures and output format; run the whole suite with
+``python -m tools.analysis``."""
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-# open(path, "w"/"wb"/"a"/"x"/... ) with the mode as a positional or
-# mode= literal; tolerates whitespace and f-string paths on one line
-_OPEN_WRITE = re.compile(
-    r"""\bopen\s*\(              # open(
-        [^()]*?,                 #   first arg (no nested parens)
-        \s*(?:mode\s*=\s*)?      #   optional mode=
-        (['"])([wax]b?\+?t?)\1   #   'w' 'wb' 'a' 'ab' 'x' ...
-    """, re.VERBOSE)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# modules allowed to open files for write directly, relative to the
-# package root.  Keep this list SHORT and justified.
-ALLOWLIST = {
-    # the tmp+rename primitive itself
-    "resilience/atomic.py",
-    # fault injection truncates files in place by design ('r+b' isn't
-    # matched anyway, but keep it pinned here for reviewers)
-    "resilience/faults.py",
-    # chrome-trace export: an append-style log artifact, not durable
-    # state; a torn trace is re-recordable
-    "profiler/profiler.py",
-    # supervisor child logs: append-style run transcripts (same class
-    # as trace exports) — a torn log line is cosmetic, and the file
-    # must be open BEFORE the child exists to capture its first bytes
-    "resilience/supervisor.py",
-}
-
-
-def check(root=None):
-    """Return a list of 'path:line: text' violations."""
-    if root is None:
-        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            os.pardir, "paddle_tpu")
-    root = os.path.abspath(root)
-    violations = []
-    for dirpath, _, files in os.walk(root):
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, name)
-            rel = os.path.relpath(full, root).replace(os.sep, "/")
-            if rel in ALLOWLIST:
-                continue
-            with open(full, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    code = line.split("#", 1)[0]
-                    if _OPEN_WRITE.search(code):
-                        violations.append(
-                            f"paddle_tpu/{rel}:{lineno}: "
-                            f"{line.strip()}")
-    return violations
-
-
-def main(argv=None):
-    violations = check(argv[0] if argv else None)
-    if violations:
-        print("non-atomic file writes (use "
-              "paddle_tpu.resilience.atomic.atomic_write):",
-              file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        return 1
-    print("check_atomic_writes: OK")
-    return 0
-
+from tools.analysis.passes.atomic_writes import check, find, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

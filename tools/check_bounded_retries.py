@@ -1,170 +1,23 @@
 #!/usr/bin/env python
-"""Lint: retry/poll loops under paddle_tpu/ must bound themselves.
-
-An unbounded retry loop is a hang with extra steps: a ``while True``
-that sleeps-and-retries around a network / store / engine call turns
-one dead peer into a wedged process the supervisor has to SIGKILL.
-The framework's contract (``resilience/retry.py``) is that every such
-loop is bounded by a :class:`Deadline` or an attempt budget
-(``max_attempts``) — this lint enforces it statically.
-
-What is flagged: a ``while True:`` / ``while 1:`` loop whose body
-contains a *blocking edge* —
-
-- any ``sleep(...)`` call (``time.sleep``, ``dl.sleep``, ...): the
-  signature of a backoff-and-retry loop;
-- a call to a blocking primitive by name (``recv``, ``accept``,
-  ``connect``, ``poll``, ``serve_forever``, ``urlopen``);
-- any call passing a ``timeout=`` keyword (a per-attempt timeout
-  inside an unbounded loop still loops forever);
-- ``next(<delays>)`` where the argument names a backoff generator
-  (``*delay*`` / ``*backoff*``)
-
-— unless the loop also references a *bound*: the ``Deadline`` class or
-a deadline-ish variable (``deadline``, ``dl``), a ``.remaining()`` /
-``.expired()`` probe, or an attempt budget identifier
-(``max_attempts`` / ``attempt`` / ``attempts`` / ``retries``).
-
-Loops shaped ``while not stop_event.is_set():`` are not ``while True``
-and are never flagged — that is the sanctioned daemon idiom.  The few
-legitimate unbounded watchers (a supervisor that watches its child
-until the child exits, the dataloader's worker-liveness poll) are
-allowlisted by ``relpath::function``.
-
-Run directly (exit 1 on violations) or import ``check()`` — a tier-1
-test wires it into the suite like ``check_atomic_writes``, so a new
-bare retry loop cannot land.
-"""
+"""Compatibility shim: the bounded-retries lint now lives in the
+unified static-analysis framework as
+:mod:`tools.analysis.passes.bounded_retries` (rule id
+``bounded-retries``).  The old module-level ``ALLOWLIST`` is empty —
+the sanctioned daemons (supervisor ``_watch``, multiprocess ``_get``)
+now carry inline ``# lint-ok: bounded-retries <reason>`` comments.
+``check()``/``main()`` keep their old signatures; run the whole suite
+with ``python -m tools.analysis``."""
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-#: unbounded-by-design loops: the supervisor watches its child until
-#: the child exits (bounded by the child's lifetime, not a deadline);
-#: the multiprocess dataloader polls worker liveness forever when the
-#: user asked for no timeout (dead workers raise instead)
-ALLOWLIST = {
-    ("resilience/supervisor.py", "_watch"),
-    ("io/multiprocess.py", "_get"),
-}
-
-_BLOCKING_NAMES = {"recv", "recv_into", "accept", "connect", "poll",
-                   "serve_forever", "urlopen"}
-_BOUND_IDS = {"deadline", "dl", "max_attempts", "attempt", "attempts",
-              "retries"}
-_BOUND_ATTRS = {"remaining", "expired"}
-
-
-def _iter_py(root):
-    for dirpath, _, files in os.walk(root):
-        for name in sorted(files):
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def _call_name(node):
-    fn = node.func
-    if isinstance(fn, ast.Name):
-        return fn.id
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    return None
-
-
-def _is_blocking(loop):
-    """Does the loop body contain a blocking-edge call?"""
-    for node in ast.walk(loop):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        if name == "sleep" or name in _BLOCKING_NAMES:
-            return True
-        if any(kw.arg == "timeout" for kw in node.keywords):
-            return True
-        if name == "next" and node.args:
-            arg = node.args[0]
-            arg_name = (arg.id if isinstance(arg, ast.Name) else
-                        arg.attr if isinstance(arg, ast.Attribute) else "")
-            if "delay" in arg_name.lower() or "backoff" in arg_name.lower():
-                return True
-    return False
-
-
-def _is_bounded(loop):
-    """Does the loop reference a Deadline / attempt budget?"""
-    for node in ast.walk(loop):
-        if isinstance(node, ast.Name):
-            ident = node.id.lower()
-            if node.id == "Deadline" or ident in _BOUND_IDS \
-                    or "deadline" in ident:
-                return True
-        elif isinstance(node, ast.Attribute):
-            attr = node.attr.lower()
-            if attr in _BOUND_ATTRS or attr in _BOUND_IDS \
-                    or "deadline" in attr:
-                return True
-    return False
-
-
-def _is_forever(test):
-    """``while True:`` / ``while 1:`` — a constant-true test."""
-    return isinstance(test, ast.Constant) and bool(test.value)
-
-
-def check(root=None, allowlist=None):
-    """Return ['relpath:line in func(): ...'] for every unbounded
-    blocking retry loop under ``root`` (default: the paddle_tpu
-    package)."""
-    if root is None:
-        root = os.path.join(HERE, os.pardir, "paddle_tpu")
-    root = os.path.abspath(root)
-    allow = ALLOWLIST if allowlist is None else set(allowlist)
-    violations = []
-    for path in _iter_py(root):
-        rel = os.path.relpath(path, root).replace(os.sep, "/")
-        with open(path, encoding="utf-8") as f:
-            try:
-                tree = ast.parse(f.read(), filename=path)
-            except SyntaxError:
-                continue
-        # map each while-loop to its innermost enclosing function
-        func_of = {}
-        for fn in ast.walk(tree):
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for node in ast.walk(fn):
-                    if isinstance(node, ast.While):
-                        func_of[node] = fn.name   # innermost wins (later)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.While) or not _is_forever(node.test):
-                continue
-            if not _is_blocking(node) or _is_bounded(node):
-                continue
-            fn_name = func_of.get(node, "<module>")
-            if (rel, fn_name) in allow:
-                continue
-            violations.append(
-                f"{rel}:{node.lineno} in {fn_name}(): unbounded "
-                f"'while True' around a blocking call — bound it with "
-                f"resilience.retry (max_attempts) or a Deadline, or "
-                f"allowlist a genuine daemon")
-    return sorted(violations)
-
-
-def main(argv=None):
-    violations = check()
-    if violations:
-        print("unbounded retry/poll loops (see tools/"
-              "check_bounded_retries.py):", file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        return 1
-    print("check_bounded_retries: OK")
-    return 0
-
+from tools.analysis.passes.bounded_retries import (  # noqa: E402,F401
+    ALLOWLIST, check, find, main)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

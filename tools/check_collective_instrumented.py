@@ -1,96 +1,21 @@
 #!/usr/bin/env python
-"""Lint: every public op in ``distributed/collective.py`` must route
-through the distributed flight recorder.
-
-A collective that isn't recorded is a blind spot exactly where
-pod-scale debugging needs eyes: the hang watchdog's desync report can
-only name the divergent seq/op if *every* op got a sequence number.
-This tool parses the module's AST, reads its ``__all__`` literal, and
-requires each exported module-level function (the op surface — group
-factories ``new_group``/``get_group`` are exempt, classes are skipped
-naturally) to carry the ``@record_collective("<op>")`` decorator from
-:mod:`paddle_tpu.observability.flight`.
-
-Run directly (exit 1 on violations) or import ``check()`` — a tier-1
-test wires it into the suite like ``check_fault_sites``, so a new
-collective op cannot land silently untraced.
-"""
+"""Compatibility shim: the collective-instrumentation lint now lives
+in the unified static-analysis framework as
+:mod:`tools.analysis.passes.collective_instrumented` (rule id
+``collective-instrumented``).  ``check()``/``main()`` keep their old
+signatures and output format; run the whole suite with
+``python -m tools.analysis``."""
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-#: exported names that are op *plumbing*, not collectives
-EXEMPT = {"new_group", "get_group"}
-
-
-def _default_path():
-    return os.path.join(HERE, os.pardir, "paddle_tpu", "distributed",
-                        "collective.py")
-
-
-def _exported_names(tree):
-    for node in tree.body:
-        if not isinstance(node, ast.Assign):
-            continue
-        for tgt in node.targets:
-            if isinstance(tgt, ast.Name) and tgt.id == "__all__":
-                return {elt.value for elt in node.value.elts
-                        if isinstance(elt, ast.Constant)
-                        and isinstance(elt.value, str)}
-    return set()
-
-
-def _decorator_name(dec):
-    f = dec.func if isinstance(dec, ast.Call) else dec
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return None
-
-
-def _instrumented(fn):
-    return any(_decorator_name(d) == "record_collective"
-               for d in fn.decorator_list)
-
-
-def check(path=None):
-    """Return ['op (path:line): problem'] for uninstrumented ops."""
-    path = os.path.abspath(path or _default_path())
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    exported = _exported_names(tree)
-    rel = os.path.relpath(path, os.path.join(HERE, os.pardir))
-    violations = []
-    for node in tree.body:
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if node.name not in exported or node.name in EXEMPT:
-            continue
-        if not _instrumented(node):
-            violations.append(
-                f"{node.name} ({rel}:{node.lineno}): public collective "
-                f"op not routed through the flight recorder — add "
-                f'@record_collective("{node.name}")')
-    return violations
-
-
-def main(argv=None):
-    uncovered = check(argv[0] if argv else None)
-    if uncovered:
-        print("silently untraced collectives "
-              "(see tools/check_collective_instrumented.py):",
-              file=sys.stderr)
-        for u in uncovered:
-            print(f"  {u}", file=sys.stderr)
-        return 1
-    print("check_collective_instrumented: OK")
-    return 0
-
+from tools.analysis.passes.collective_instrumented import (  # noqa: E402,F401
+    EXEMPT, check, find, main)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

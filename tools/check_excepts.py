@@ -1,130 +1,21 @@
 #!/usr/bin/env python
-"""Lint: no silent broad-exception swallows under paddle_tpu/.
-
-A bare ``except Exception: pass`` is how silent corruption gets a
-foothold: the failure the handler ate is exactly the evidence an
-operator needed, and five PRs of resilience machinery (fault sites,
-health anomalies, the integrity sentinel) are worthless for a failure
-that never surfaces.  This tool walks every handler under the package
-and flags any that
-
-- catches **broadly** — a bare ``except:``, ``Exception`` or
-  ``BaseException`` (alone or inside a tuple), and
-- **does nothing** — a body of only ``pass`` / ``continue`` / ``break``
-  / constant expressions (a string "comment" counts as nothing).
-
-A flagged handler must log, re-raise, recover with real code, narrow
-its exception list, or carry an explicit allowlist comment::
-
-    except Exception:
-        pass            # silent-ok: <why swallowing here is correct>
-
-anywhere on its source lines.  The reason is mandatory — a naked
-``silent-ok:`` is still a violation.  The genuine cleanup paths
-(resource-tracker deregistration in ``io/multiprocess.py``,
-interpreter-shutdown destructors, best-effort store key deletion) are
-seeded with such comments; everything new must justify itself the same
-way.
-
-Run directly (exit 1 on violations) or import ``check()`` — a tier-1
-test wires it into the suite so a new silent swallow cannot land.
-"""
+"""Compatibility shim: the silent-excepts lint now lives in the
+unified static-analysis framework as
+:mod:`tools.analysis.passes.excepts` (rule id ``excepts``).  Both the
+rule-native ``# silent-ok: <reason>`` marker and the uniform
+``# lint-ok: excepts <reason>`` comment suppress a handler.
+``check()``/``main()`` keep their old signatures and output format;
+run the whole suite with ``python -m tools.analysis``."""
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-MARKER = re.compile(r"#\s*silent-ok:\s*\S")
-
-_BROAD = ("Exception", "BaseException")
-
-
-def _iter_py(root):
-    for dirpath, _, files in os.walk(root):
-        for name in sorted(files):
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def _catches_broadly(handler):
-    t = handler.type
-    if t is None:                           # bare except:
-        return True
-
-    def name_of(node):
-        if isinstance(node, ast.Name):
-            return node.id
-        if isinstance(node, ast.Attribute):
-            return node.attr
-        return None
-
-    if isinstance(t, ast.Tuple):
-        return any(name_of(e) in _BROAD for e in t.elts)
-    return name_of(t) in _BROAD
-
-
-def _does_nothing(handler):
-    for stmt in handler.body:
-        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
-            continue
-        if isinstance(stmt, ast.Expr) and \
-                isinstance(stmt.value, ast.Constant):
-            continue                        # docstring / ellipsis
-        return False
-    return True
-
-
-def _allowlisted(handler, lines):
-    last = max(getattr(s, "end_lineno", s.lineno) for s in handler.body)
-    blob = "\n".join(lines[handler.lineno - 1:last])
-    return bool(MARKER.search(blob))
-
-
-def check(root=None):
-    """Return ['relpath:lineno: except <what>'] for every silent broad
-    swallow without a ``silent-ok:`` reason."""
-    if root is None:
-        root = os.path.join(HERE, os.pardir, "paddle_tpu")
-    root = os.path.abspath(root)
-    out = []
-    for path in _iter_py(root):
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        try:
-            tree = ast.parse(src, filename=path)
-        except SyntaxError:
-            continue
-        lines = src.splitlines()
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if not (_catches_broadly(node) and _does_nothing(node)):
-                continue
-            if _allowlisted(node, lines):
-                continue
-            what = ("bare except" if node.type is None
-                    else f"except {ast.unparse(node.type)}")
-            rel = os.path.relpath(path, os.path.dirname(root))
-            out.append(f"{rel}:{node.lineno}: {what}")
-    return sorted(out)
-
-
-def main(argv=None):
-    bad = check()
-    if bad:
-        print("silent broad-exception swallows (log, re-raise, narrow "
-              "the exception, or add '# silent-ok: <reason>'):",
-              file=sys.stderr)
-        for b in bad:
-            print(f"  {b}", file=sys.stderr)
-        return 1
-    print("check_excepts: OK (no silent broad swallows)")
-    return 0
-
+from tools.analysis.passes.excepts import MARKER, check, find, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

@@ -1,111 +1,21 @@
 #!/usr/bin/env python
-"""Lint: every fault site registered under paddle_tpu/ must be
-exercised by at least one test.
-
-The resilience story rests on named fault sites
-(``resilience.faults.fault_point``) being *killed at* by the
-crash-consistency matrix — a site nobody injects is a recovery path
-nobody has proven.  This tool collects every site name declared in the
-package (positional ``fault_point("...")`` literals and ``site="..."``
-keyword literals, e.g. ``atomic_write(..., site=...)``) and checks
-that each name appears somewhere under tests/ — in an injected spec, a
-``PADDLE_TPU_FAULTS`` string, or a generated worker script.
-
-Keyword *defaults* (like ``atomic_write``'s ``site="io.write"``) are
-declarations of a parameter, not registrations of a site, and are
-skipped — call sites that rely on the default are linted at the
-callee's own named sites.
-
-Run directly (exit 1 on uncovered sites) or import ``check()`` — a
-tier-1 test wires it into the suite so a new ``fault_point`` cannot
-land without a test that fires it.
-"""
+"""Compatibility shim: the fault-site coverage lint now lives in the
+unified static-analysis framework as
+:mod:`tools.analysis.passes.fault_sites` (rule id ``fault-sites``).
+``check()``/``collect_sites()``/``covered_sites()``/``main()`` keep
+their old signatures; run the whole suite with
+``python -m tools.analysis``."""
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-def _iter_py(root):
-    for dirpath, _, files in os.walk(root):
-        for name in sorted(files):
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def collect_sites(root=None):
-    """``{site_name: 'relpath:lineno'}`` for every literal fault site
-    declared under ``root`` (default: the paddle_tpu package)."""
-    if root is None:
-        root = os.path.join(HERE, os.pardir, "paddle_tpu")
-    root = os.path.abspath(root)
-    sites = {}
-
-    def note(name, path, lineno):
-        rel = os.path.relpath(path, os.path.dirname(root))
-        sites.setdefault(name, f"{rel}:{lineno}")
-
-    for path in _iter_py(root):
-        with open(path, encoding="utf-8") as f:
-            try:
-                tree = ast.parse(f.read(), filename=path)
-            except SyntaxError:
-                continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            fn_name = fn.id if isinstance(fn, ast.Name) else (
-                fn.attr if isinstance(fn, ast.Attribute) else None)
-            if fn_name == "fault_point" and node.args and \
-                    isinstance(node.args[0], ast.Constant) and \
-                    isinstance(node.args[0].value, str):
-                note(node.args[0].value, path, node.lineno)
-            for kw in node.keywords:
-                if kw.arg == "site" and \
-                        isinstance(kw.value, ast.Constant) and \
-                        isinstance(kw.value.value, str):
-                    note(kw.value.value, path, node.lineno)
-    return sites
-
-
-def covered_sites(sites, tests_root=None):
-    """The subset of ``sites`` whose name appears in any test file."""
-    if tests_root is None:
-        tests_root = os.path.join(HERE, os.pardir, "tests")
-    tests_root = os.path.abspath(tests_root)
-    blob = []
-    for path in _iter_py(tests_root):
-        with open(path, encoding="utf-8") as f:
-            blob.append(f.read())
-    blob = "\n".join(blob)
-    return {s for s in sites if s in blob}
-
-
-def check(root=None, tests_root=None):
-    """Return ['site (declared at path:line)'] for uncovered sites."""
-    sites = collect_sites(root)
-    covered = covered_sites(sites, tests_root)
-    return [f"{name} (declared at {where})"
-            for name, where in sorted(sites.items())
-            if name not in covered]
-
-
-def main(argv=None):
-    uncovered = check()
-    if uncovered:
-        print("fault sites with no exercising test (add a matrix case "
-              "in tests/, e.g. injected_faults(FaultSpec(site, ...))):",
-              file=sys.stderr)
-        for u in uncovered:
-            print(f"  {u}", file=sys.stderr)
-        return 1
-    print(f"check_fault_sites: OK ({len(collect_sites())} sites covered)")
-    return 0
-
+from tools.analysis.passes.fault_sites import (  # noqa: E402,F401
+    check, collect_sites, covered_sites, find, main)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

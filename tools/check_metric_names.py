@@ -1,127 +1,19 @@
 #!/usr/bin/env python
-"""Lint: metric names registered under paddle_tpu/ must follow
-Prometheus naming conventions.
-
-A metrics surface is only useful if dashboards can rely on its shape:
-``rate()`` over something not named ``*_total`` is a silent lie, a
-camelCase name breaks every recording rule, and one name registered as
-a counter here and a gauge there poisons the whole series.  Statically
-scanned rules (literal first-argument names to ``Counter(`` /
-``Gauge(`` / ``Histogram(`` and ``registry.counter(`` & co.):
-
-- names are ``snake_case`` (``^[a-z][a-z0-9_]*$``);
-- counter names end in ``_total``;
-- a name never appears with two different metric kinds across the
-  codebase;
-- unit suffixes are canonical: a gauge or histogram name must not use
-  an abbreviated unit (``_s``, ``_ms``, ``_secs``, ``_kb``, ``_pct``,
-  ...) — spell it ``_seconds`` / ``_bytes`` / ``_ratio``;
-- histograms always measure a quantity, so a histogram name must END
-  in one of the canonical unit suffixes (a ``step_time`` histogram
-  whose unit a dashboard has to guess is a recording-rule bug waiting
-  to happen).  Unitless gauges (counts, 0/1 flags) stay suffix-free.
-
-Run directly (exit 1 on violations) or import ``check()`` — a tier-1
-test wires it into the suite like ``check_atomic_writes``, so a
-nonconforming metric fails CI, not a dashboard review.
-"""
+"""Compatibility shim: the metric-naming lint now lives in the unified
+static-analysis framework as :mod:`tools.analysis.passes.metric_names`
+(rule id ``metric-names``).  ``check()``/``main()`` keep their old
+signatures and output format; run the whole suite with
+``python -m tools.analysis``."""
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-# Counter("name"...) / Gauge( / Histogram(  — constructor form — and
-# <registry>.counter("name"...) / .gauge( / .histogram( — get-or-create
-# form.  Only literal names are checkable statically; a variable name
-# is skipped (there are none today — keep it that way).
-_METRIC_CALL = re.compile(
-    r"""(?:\b(?P<cls>Counter|Gauge|Histogram)
-         |\.(?P<meth>counter|gauge|histogram))
-        \s*\(\s*(?P<q>['"])(?P<name>[^'"]+)(?P=q)""", re.VERBOSE)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
-
-# canonical unit suffixes for quantity-bearing series
-_UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio")
-# abbreviated / non-canonical unit spellings that MUST NOT end a gauge
-# or histogram name
-_BAD_UNIT = re.compile(
-    r"_(s|sec|secs|ms|millis|micros|us|ns|min|mins|minutes|hr|hrs|"
-    r"hours|kb|mb|gb|tb|kib|mib|gib|pct|percent)$")
-
-
-def check(root=None):
-    """Return a list of 'path:line: problem' violations."""
-    if root is None:
-        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            os.pardir, "paddle_tpu")
-    root = os.path.abspath(root)
-    violations = []
-    seen = {}                    # name -> (kind, "path:line")
-    for dirpath, _, files in os.walk(root):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fname)
-            rel = "paddle_tpu/" + \
-                os.path.relpath(full, root).replace(os.sep, "/")
-            with open(full, encoding="utf-8") as f:
-                # strip per-line comments but keep the scan whole-file:
-                # a call split across lines (Counter(\n  "name")) must
-                # still be seen, since \s* matches the newline
-                code = "\n".join(line.split("#", 1)[0]
-                                 for line in f.read().splitlines())
-            for m in _METRIC_CALL.finditer(code):
-                kind = (m.group("cls") or m.group("meth")).lower()
-                name = m.group("name")
-                lineno = code.count("\n", 0, m.start()) + 1
-                where = f"{rel}:{lineno}"
-                if not _SNAKE.match(name):
-                    violations.append(
-                        f"{where}: metric name {name!r} is not "
-                        "snake_case")
-                if kind == "counter" and not name.endswith("_total"):
-                    violations.append(
-                        f"{where}: counter {name!r} must end in "
-                        "'_total' (Prometheus convention)")
-                if kind in ("gauge", "histogram"):
-                    m_bad = _BAD_UNIT.search(name)
-                    if m_bad:
-                        violations.append(
-                            f"{where}: {kind} {name!r} uses the "
-                            f"non-canonical unit suffix "
-                            f"'_{m_bad.group(1)}' — spell it out "
-                            f"({'/'.join(_UNIT_SUFFIXES)})")
-                    elif kind == "histogram" and \
-                            not name.endswith(_UNIT_SUFFIXES):
-                        violations.append(
-                            f"{where}: histogram {name!r} must end in "
-                            f"a canonical unit suffix "
-                            f"({'/'.join(_UNIT_SUFFIXES)})")
-                prev = seen.get(name)
-                if prev is not None and prev[0] != kind:
-                    violations.append(
-                        f"{where}: {name!r} registered as {kind} "
-                        f"but as {prev[0]} at {prev[1]} — one "
-                        "name, one type")
-                else:
-                    seen.setdefault(name, (kind, where))
-    return violations
-
-
-def main(argv=None):
-    violations = check(argv[0] if argv else None)
-    if violations:
-        print("metric naming violations "
-              "(Prometheus conventions, see tools/check_metric_names.py):",
-              file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        return 1
-    print("check_metric_names: OK")
-    return 0
-
+from tools.analysis.passes.metric_names import check, find, main  # noqa: E402,F401
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))
