@@ -29,6 +29,8 @@ from .collective import (  # noqa: F401
     ReduceOp,
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import mesh  # noqa: F401
+from .mesh import build_mesh, replica_peers  # noqa: F401
 from . import fleet  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import auto_parallel  # noqa: F401

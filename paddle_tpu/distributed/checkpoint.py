@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 
 import numpy as np
 
@@ -41,7 +43,198 @@ from ..resilience.atomic import atomic_write
 from ..resilience.faults import fault_point
 
 __all__ = ["save_sharded", "load_sharded", "save_engine_state",
-           "load_engine_state"]
+           "load_engine_state", "CommitBarrier", "CommitBarrierError"]
+
+
+# ------------------------------------------------------ commit barrier
+
+
+class CommitBarrierError(RuntimeError):
+    """The multi-host commit barrier did not complete: a rank failed to
+    ack its shards (or the committer died) within the timeout.  The
+    checkpoint was NOT committed — ``latest()`` still names the
+    previous step on every rank."""
+
+
+class CommitBarrier:
+    """Multi-host checkpoint commit coordination over TCPStore.
+
+    The single-process commit point (one ``os.replace``) does not
+    survive multiple hosts: each host writes only its *addressable*
+    shards, so a manifest committed by rank 0 while rank 3 is still
+    writing (or dead) would name shards that never hit the shared
+    filesystem.  The barrier serializes the commit:
+
+    1. every rank writes its shards, then :meth:`ack`\\ s its shard
+       CRCs (fault site ``checkpoint.shard_ack`` fires *before* the
+       ack is published — a ``stall`` there is a slow rank, a ``kill``
+       a rank dying pre-ack);
+    2. rank 0's :meth:`commit` waits for all ``world_size`` acks, fires
+       ``checkpoint.before_barrier_commit``, runs the commit function
+       (the ``os.replace``), and publishes the committed marker;
+    3. every other rank's :meth:`commit` blocks on that marker.
+
+    A rank killed before its ack starves step 2: rank 0 times out with
+    :class:`CommitBarrierError`, nothing is renamed, and ``latest()``
+    on every survivor still resolves the previous checkpoint.  Tokens
+    are generation-qualified (:meth:`begin`), so a retried save of the
+    same step cannot be satisfied by a dead attempt's stale acks.
+    """
+
+    def __init__(self, store, rank, world_size, timeout=30.0,
+                 key_prefix="ckpt_commit"):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.timeout = float(timeout)
+        self.key_prefix = key_prefix
+        self._lock = threading.Lock()
+        self._gen = {}       # guarded-by: self._lock  token -> generation
+        self._acks = {}      # guarded-by: self._lock  token -> {rank: crcs}
+        self._state = {}     # guarded-by: self._lock  token -> phase str
+
+    def _key(self, token, gen, leaf):
+        return f"{self.key_prefix}/{token}/g{int(gen)}/{leaf}"
+
+    def begin(self, token, prepare=None):
+        """Open a commit attempt for ``token``; returns its generation.
+
+        Rank 0 bumps the generation counter, runs ``prepare`` (e.g.
+        pre-cleaning a tmp directory — done HERE so no peer is mid-write
+        in it yet), and publishes the generation; other ranks block on
+        it before touching shared paths."""
+        if self.rank == 0:
+            gen = self.store.add(f"{self.key_prefix}/{token}/gen", 1)
+            if prepare is not None:
+                prepare()
+            self.store.set(f"{self.key_prefix}/{token}/open",
+                           str(gen))
+        else:
+            deadline = time.monotonic() + self.timeout
+            while True:
+                remaining = max(0.05, deadline - time.monotonic())
+                raw = self.store.get(f"{self.key_prefix}/{token}/open",
+                                     blocking=True, timeout=remaining)
+                gen = int(raw)
+                with self._lock:
+                    stale = self._gen.get(token)
+                # a generation already committed or aborted is a DEAD
+                # attempt's leftover (this process may have restarted
+                # since): wait for rank 0 to open a fresh one
+                if (stale is None or gen > stale) \
+                        and not self._finished(token, gen):
+                    break
+                if time.monotonic() > deadline:   # lint-ok: bounded-retries deadline-bounded poll
+                    raise CommitBarrierError(
+                        f"commit barrier {token!r}: no new generation "
+                        f"within {self.timeout}s (stuck at g{gen})")
+                time.sleep(0.005)
+        with self._lock:
+            self._gen[token] = gen
+            self._state[token] = "open"
+        return gen
+
+    def _finished(self, token, gen):
+        for leaf in ("committed", "aborted"):
+            try:
+                self.store.get(self._key(token, gen, leaf),
+                               blocking=False)
+                return True
+            except KeyError:
+                pass
+        return False
+
+    def _abort(self, token, gen, why):
+        """Mark a generation terminally failed so a later retry's
+        joiners cannot mistake its leftovers for a live attempt; safe
+        to race with a commit (joiners check committed first, and a
+        set here never un-renames anything)."""
+        try:
+            self.store.set(self._key(token, gen, "aborted"), why)
+        except (OSError, RuntimeError):
+            pass    # silent-ok: best-effort tombstone while failing anyway
+        with self._lock:
+            self._state[token] = "failed"
+
+    def _generation(self, token):
+        with self._lock:
+            gen = self._gen.get(token)
+        if gen is None:
+            gen = self.begin(token)
+        return gen
+
+    def ack(self, token, crcs):
+        """Publish this rank's shard-CRC digest for ``token``.  The
+        fault site fires BEFORE the store write: a fault here models a
+        rank that finished writing shards but never told anyone."""
+        gen = self._generation(token)
+        fault_point("checkpoint.shard_ack")
+        self.store.set(self._key(token, gen, f"ack/rank_{self.rank}"),
+                       json.dumps({"rank": self.rank,
+                                   "crcs": dict(crcs or {})}))
+        with self._lock:
+            self._state[token] = "acked"
+
+    def _collect_acks(self, token, gen):
+        acks = {}
+        deadline = time.monotonic() + self.timeout
+        for r in range(self.world_size):
+            remaining = max(0.05, deadline - time.monotonic())
+            try:
+                raw = self.store.get(
+                    self._key(token, gen, f"ack/rank_{r}"),
+                    blocking=True, timeout=remaining)
+            except (KeyError, TimeoutError):
+                self._abort(token, gen, f"rank {r} never acked")
+                raise CommitBarrierError(
+                    f"commit barrier {token!r} (g{gen}): rank {r} never "
+                    f"acked its shards within {self.timeout}s — "
+                    f"checkpoint NOT committed") from None
+            acks[r] = json.loads(raw)
+        return acks
+
+    def commit(self, token, fn=None):
+        """Complete the barrier.  Rank 0: wait for every rank's ack,
+        fire ``checkpoint.before_barrier_commit``, run ``fn`` (THE
+        commit — e.g. the directory/manifest ``os.replace``), publish
+        the committed marker, and return the collected acks.  Other
+        ranks: block on the marker (``fn`` is ignored); timeout raises
+        :class:`CommitBarrierError` with nothing committed anywhere."""
+        gen = self._generation(token)
+        if self.rank == 0:
+            acks = self._collect_acks(token, gen)
+            with self._lock:
+                self._acks[token] = {r: a.get("crcs", {})
+                                     for r, a in acks.items()}
+            fault_point("checkpoint.before_barrier_commit")
+            if fn is not None:
+                fn()
+            self.store.set(self._key(token, gen, "committed"),
+                           json.dumps(sorted(acks)))
+            with self._lock:
+                self._state[token] = "committed"
+            return acks
+        try:
+            self.store.get(self._key(token, gen, "committed"),
+                           blocking=True, timeout=self.timeout)
+        except (KeyError, TimeoutError):
+            self._abort(token, gen, "commit marker never appeared")
+            raise CommitBarrierError(
+                f"commit barrier {token!r} (g{gen}): commit marker "
+                f"never appeared within {self.timeout}s — rank 0 died "
+                f"or a peer never acked; previous checkpoint remains "
+                f"current") from None
+        with self._lock:
+            self._state[token] = "committed"
+        return None
+
+    def status(self):
+        """Introspection snapshot (exporter/debug surface)."""
+        with self._lock:
+            return {"rank": self.rank, "world_size": self.world_size,
+                    "tokens": dict(self._state),
+                    "acked_ranks": {t: sorted(a)
+                                    for t, a in self._acks.items()}}
 
 
 def _leaf_id(path_str):
@@ -75,15 +268,29 @@ def _index_to_json(index, shape):
     return out
 
 
-def save_sharded(path, tree, step=None, extra=None):
+def save_sharded(path, tree, step=None, extra=None, rank=None,
+                 barrier=None):
     """Save a pytree of (possibly sharded) jax arrays: one .npy per
     addressable device shard + a manifest of index windows.  Duplicate
     windows (replicated axes) are written once.
 
     Multi-process: each process writes ONLY its addressable shards into
     rank-prefixed files and its own ``manifest.<rank>.json``
-    (dist_saver's per-rank dump); loading unions every rank's manifest."""
-    rank = jax.process_index()
+    (dist_saver's per-rank dump); loading unions every rank's manifest.
+
+    ``barrier`` (a :class:`CommitBarrier`) makes the manifest commit
+    globally consistent: every rank lands its manifest as a
+    ``.pending`` file (invisible to :func:`load_sharded`'s glob), acks
+    its shard CRCs through the store, and rank 0 renames ALL pending
+    manifests to their final names only after the full ack set arrived
+    — a rank killed pre-ack leaves the directory manifest-less (or the
+    previous checkpoint's manifests intact) on every host.  ``rank``
+    overrides ``jax.process_index()`` (multi-host simulation in tests;
+    defaults to the barrier's rank when one is given)."""
+    if rank is None:
+        rank = barrier.rank if barrier is not None \
+            else jax.process_index()
+    rank = int(rank)
     tag = f"r{rank}"
     os.makedirs(path, exist_ok=True)
     flat, treedef, paths = _tree_paths(tree)
@@ -140,10 +347,34 @@ def save_sharded(path, tree, step=None, extra=None):
     # written LAST and atomically: a readable manifest implies complete
     # shards (the commit point within this directory)
     fault_point("checkpoint.before_manifest", path=path)
-    with atomic_write(os.path.join(path, f"manifest.{rank}.json"), "w",
+    final_name = os.path.join(path, f"manifest.{rank}.json")
+    if barrier is None:
+        with atomic_write(final_name, "w",
+                          site="checkpoint.manifest_write") as f:
+            json.dump(manifest, f, indent=1)
+        return manifest
+    # barrier mode: manifests stay .pending (load_sharded cannot see
+    # them) until rank 0 has every rank's CRC ack — then ONE rank
+    # renames them all, atomically each, as THE commit
+    with atomic_write(final_name + ".pending", "w",
                       site="checkpoint.manifest_write") as f:
         json.dump(manifest, f, indent=1)
+    crcs = {f"{l['id']}/{s['file']}": s["crc32"]
+            for l in leaves for s in l["shards"]}
+    token = os.path.basename(os.path.normpath(path))
+    barrier.ack(token, crcs)
+    barrier.commit(token, fn=lambda: _commit_pending_manifests(path))
     return manifest
+
+
+def _commit_pending_manifests(path):
+    """Rank 0's barrier commit: publish every rank's pending manifest
+    (each rename atomic; all shards are already acked on disk)."""
+    import glob
+
+    for pend in sorted(glob.glob(
+            os.path.join(path, "manifest.*.json.pending"))):
+        os.replace(pend, pend[:-len(".pending")])
 
 
 def _load_manifest(path):

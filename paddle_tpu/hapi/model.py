@@ -53,7 +53,9 @@ class Model:
         self._jit_step = None
         self._jit_eval = None
         self._opt_state = None   # functional optimizer state (jit path)
-        self._mesh = None        # dp mesh (prepare(device_mesh=...))
+        self._mesh = None        # mesh.py mesh (prepare(device_mesh=...))
+        self._shard_plan = None  # resolved GSPMD spec trees, built lazily
+        self._extra_rules = ()   # user sharding rules ahead of GPT_RULES
         self._watch_grad_norm = False   # train_batch reports grad_norm
         self._jit_step_gnorm = False    # arity the built step returns
         self._rollback_request = None   # set by HealthMonitor(rollback)
@@ -73,22 +75,85 @@ class Model:
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                device_mesh=None):
+                device_mesh=None, sharding_rules=()):
         """``device_mesh``: None = single device; "auto" = data-parallel
-        over every local device; or a jax.sharding.Mesh with a "dp" axis.
-        The reference wires DP implicitly via prepare_distributed_context
-        (hapi/model.py:191) when launched under fleet — on TPU the mesh
-        IS that context: the batch is sharded over "dp", params stay
-        replicated, and XLA inserts the gradient all-reduce."""
+        over every local device; or a ``distributed.mesh`` Mesh with any
+        of the ``dp``/``mp``/``sharding`` axes.  The reference wires DP
+        implicitly via prepare_distributed_context (hapi/model.py:191)
+        when launched under fleet — on TPU the mesh IS that context:
+        the batch shards over "dp", params follow the mesh.py rule
+        table (mp column/row splits for transformer leaves, replicated
+        otherwise), optimizer state additionally spreads over the
+        "sharding" axis (ZeRO), and XLA inserts every collective.
+        ``sharding_rules``: (regex, PartitionSpec) pairs consulted
+        BEFORE the GPT table — how a non-GPT network names its own
+        splits."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         if device_mesh == "auto":
-            from jax.sharding import Mesh
+            from ..distributed import mesh as mesh_mod
 
-            device_mesh = Mesh(np.array(jax.devices()), ("dp",))
+            device_mesh = mesh_mod.build_mesh(dp=len(jax.devices()))
         self._mesh = device_mesh
+        self._extra_rules = tuple(sharding_rules)
+        self._shard_plan = None
+        self._jit_step = None
+        self._jit_eval = None
         return self
+
+    # ---------------------------------------------------- GSPMD sharding
+    def _mesh_plan(self, params, buffers):
+        """Resolve (and cache) the mesh.py spec trees for this network:
+        params under the rule table, buffers replicated, optimizer
+        slots ZeRO-sharded over the "sharding" axis.  Built lazily at
+        the first batch — the param tree must exist first."""
+        if self._shard_plan is not None:
+            return self._shard_plan
+        from ..distributed import mesh as mesh_mod
+
+        mesh = self._mesh
+        pspecs = mesh_mod.param_specs(params, mesh,
+                                      extra_rules=self._extra_rules)
+        from jax.sharding import PartitionSpec as P
+
+        opt = self._optimizer
+        if opt is not None and hasattr(opt, "apply_gradients"):
+            if self._opt_state is None:
+                self._opt_state = opt.init_state(params)
+            ospecs = {"step": P(),
+                      "slots": mesh_mod.zero_opt_specs(
+                          pspecs, self._opt_state["slots"], mesh)}
+        else:
+            ospecs = None           # eval-only / eager optimizer path
+        bspecs = jax.tree_util.tree_map(lambda _: P(), buffers)
+        self._shard_plan = {"params": pspecs, "opt": ospecs,
+                            "buffers": bspecs}
+        return self._shard_plan
+
+    def _place_state(self, params, buffers):
+        """Promote live network params / buffers / opt state onto the
+        mesh under the resolved plan (device_put is a no-op once they
+        already carry the right sharding) and write the sharded arrays
+        back into the network, so ``addressable_shards`` on any
+        parameter reflects the real layout between steps."""
+        from ..distributed import mesh as mesh_mod
+
+        plan = self._mesh_plan(params, buffers)
+        mesh = self._mesh
+        params = mesh_mod.shard_tree(params, mesh, plan["params"])
+        buffers = mesh_mod.shard_tree(buffers, mesh, plan["buffers"])
+        if plan["opt"] is not None:
+            self._opt_state = mesh_mod.shard_tree(
+                self._opt_state, mesh, plan["opt"])
+        named = dict(self.network.named_parameters())
+        for k, v in params.items():
+            named[k].data = v
+        named_b = {k: b for k, b in self.network.named_buffers()
+                   if b is not None}
+        for k, v in buffers.items():
+            named_b[k].data = v
+        return params, buffers
 
     # ---------------------------------------------------------- jit pieces
     def _build_jit_step(self):
@@ -125,7 +190,32 @@ class Model:
             return new_params, new_opt, loss, out, new_buffers
 
         self._jit_step_gnorm = log_gnorm
-        self._jit_step = watch(jax.jit(step), name="hapi::train_step")
+        jit_kw = {}
+        if self._mesh is not None and self._shard_plan is not None:
+            # the GSPMD contract: inputs pinned to the mesh.py plan,
+            # outputs land already-sharded (no implicit gather), params
+            # + opt state donated so the update is in-place on-device
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._mesh
+            ns = lambda s: NamedSharding(mesh, s)
+            as_sh = lambda tree: jax.tree_util.tree_map(
+                ns, tree, is_leaf=lambda x: isinstance(x, P))
+            p_sh = as_sh(self._shard_plan["params"])
+            b_sh = as_sh(self._shard_plan["buffers"])
+            o_sh = as_sh(self._shard_plan["opt"])
+            batch_sh, rep = ns(P("dp")), ns(P())
+            out_sh = (p_sh, o_sh, rep, batch_sh, b_sh)
+            if log_gnorm:
+                out_sh = out_sh + (rep,)
+            jit_kw = dict(
+                in_shardings=(p_sh, b_sh, o_sh, batch_sh, batch_sh,
+                              rep),
+                out_shardings=out_sh)
+            if jax.default_backend() != "cpu":
+                jit_kw["donate_argnums"] = (0, 2)
+        self._jit_step = watch(jax.jit(step, **jit_kw),
+                               name="hapi::train_step")
         return self._jit_step
 
     def _shard_batch(self, x, y):
@@ -140,9 +230,9 @@ class Model:
         few duplicated samples bias one tail step negligibly)."""
         if self._mesh is None:
             return x, y
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..distributed import mesh as mesh_mod
 
-        dp = self._mesh.shape["dp"]
+        dp = mesh_mod.mesh_axis(self._mesh, "dp")
         n = x.shape[0]
         if n % dp:
             keep = (n // dp) * dp
@@ -154,8 +244,7 @@ class Model:
                 reps = dp - n
                 x = _np.concatenate([x] + [x[-1:]] * reps, axis=0)
                 y = _np.concatenate([y] + [y[-1:]] * reps, axis=0)
-        sh = NamedSharding(self._mesh, P("dp"))
-        return jax.device_put(x, sh), jax.device_put(y, sh)
+        return mesh_mod.shard_batch(self._mesh, x, y)
 
     # ------------------------------------------------- train / eval batch
     def train_batch(self, inputs, labels):
@@ -166,7 +255,9 @@ class Model:
         opt = self._optimizer
         if hasattr(opt, "apply_gradients"):
             params, buffers = self.network.raw_state()
-            if self._opt_state is None:
+            if self._mesh is not None:
+                params, buffers = self._place_state(params, buffers)
+            elif self._opt_state is None:
                 self._opt_state = opt.init_state(params)
             step = self._build_jit_step()
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -268,6 +359,8 @@ class Model:
         y = _as_array(_to_list(labels)[0])
         x, y = self._shard_batch(x, y)
         params, buffers = self.network.raw_state()
+        if self._mesh is not None:
+            params, buffers = self._place_state(params, buffers)
 
         if self._jit_eval is None:
             net, loss_fn = self.network, self._loss
@@ -281,7 +374,22 @@ class Model:
                      jnp.zeros(())) if loss is not None else jnp.zeros(())
                 return l, out_arr
 
-            self._jit_eval = watch(jax.jit(ev), name="hapi::eval_step")
+            jit_kw = {}
+            if self._mesh is not None and self._shard_plan is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                mesh = self._mesh
+                ns = lambda s: NamedSharding(mesh, s)
+                as_sh = lambda tree: jax.tree_util.tree_map(
+                    ns, tree, is_leaf=lambda s: isinstance(s, P))
+                batch_sh = ns(P("dp"))
+                jit_kw = dict(
+                    in_shardings=(as_sh(self._shard_plan["params"]),
+                                  as_sh(self._shard_plan["buffers"]),
+                                  batch_sh, batch_sh),
+                    out_shardings=(ns(P()), batch_sh))
+            self._jit_eval = watch(jax.jit(ev, **jit_kw),
+                                   name="hapi::eval_step")
         with RecordEvent("hapi::eval_step"):
             loss, out = self._jit_eval(params, buffers, x, y)
         results = self._update_metrics(out, y)

@@ -149,17 +149,25 @@ class CheckpointManager:
     """Atomic, checksummed, retained checkpoints under one directory."""
 
     def __init__(self, directory, keep_last_n=None, async_save=False,
-                 sweep_orphans=True, verify_on_save=False):
+                 sweep_orphans=True, verify_on_save=False, barrier=None):
         self.directory = os.fspath(directory)
         self.keep_last_n = keep_last_n
         self.async_save = bool(async_save)
         self.verify_on_save = bool(verify_on_save)
+        # multi-host: a distributed.checkpoint.CommitBarrier makes the
+        # step-directory rename rank-0-only and gated on every rank's
+        # shard-CRC ack — latest() is then globally consistent
+        self._barrier = barrier
         # _thread is owned by the training thread (save/wait only);
         # _error crosses from the background save thread into wait()
         self._lock = threading.Lock()
         self._thread = None
         self._error = None      # guarded-by: self._lock
         os.makedirs(self.directory, exist_ok=True)
+        if barrier is not None and barrier.rank != 0:
+            # only the committing rank may mutate shared directories
+            # outside its own shard files
+            sweep_orphans = False
         if sweep_orphans:
             # reclaim step_N.tmp debris from a save killed mid-write in
             # a PREVIOUS process (a crashed trainer's relaunch lands
@@ -262,6 +270,9 @@ class CheckpointManager:
     def _write_and_commit(self, tree, step, extra, verify=False):
         from ..distributed.checkpoint import save_sharded
 
+        if self._barrier is not None:
+            return self._write_and_commit_multihost(tree, step, extra,
+                                                    verify)
         final = self.step_path(step)
         tmp = final + ".tmp"
         if os.path.exists(tmp):             # debris from a crashed save
@@ -283,6 +294,50 @@ class CheckpointManager:
         if verify:
             # audit BEFORE retention: a save that fails its re-read
             # must never cause the good checkpoints to be GC'd
+            ok, errors = verify_checkpoint(final)
+            if not ok:
+                self._count("checkpoint_audit_failures_total")
+                raise CheckpointAuditError(step, errors)
+        self._gc()
+
+    def _write_and_commit_multihost(self, tree, step, extra, verify):
+        """The barrier-gated save: every rank writes its addressable
+        shards into ONE shared ``step_N.tmp``, acks its shard CRCs,
+        and rank 0 performs the directory rename only after the full
+        ack set arrived — then (alone) audits and GCs.  A rank dying
+        before its ack starves the barrier: rank 0 raises
+        :class:`~paddle_tpu.distributed.checkpoint.CommitBarrierError`
+        with the tmp directory never renamed, so ``latest()`` on every
+        surviving rank still resolves the previous step."""
+        from ..distributed.checkpoint import save_sharded
+
+        b = self._barrier
+        final = self.step_path(step)
+        tmp = final + ".tmp"
+        token = _step_dirname(step)
+
+        def _prepare():
+            if os.path.exists(tmp):         # debris from a crashed save
+                shutil.rmtree(tmp)
+
+        b.begin(token, prepare=_prepare)
+        manifest = save_sharded(tmp, tree, step=int(step), extra=extra,
+                                rank=b.rank)
+        crcs = {f"{l['id']}/{s['file']}": s["crc32"]
+                for l in manifest["leaves"] for s in l["shards"]}
+        b.ack(token, crcs)
+
+        def _commit():
+            fault_point("checkpoint.before_commit", path=tmp)
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)          # THE commit point
+        b.commit(token, fn=_commit)
+        if b.rank != 0:
+            return
+        fault_point("checkpoint.after_commit", path=final)
+        self._count("checkpoint_commits_total")
+        if verify:
             ok, errors = verify_checkpoint(final)
             if not ok:
                 self._count("checkpoint_audit_failures_total")

@@ -61,8 +61,9 @@ import zlib
 # layer stack, so the sentinel callback must not import paddle_tpu.hapi
 from ..observability.goodput import TrainingCallback
 
-__all__ = ["tree_fingerprint", "first_divergent_leaf",
-           "majority_partition", "compare_digests", "IntegrityCallback"]
+__all__ = ["tree_fingerprint", "shard_fingerprint",
+           "first_divergent_leaf", "majority_partition",
+           "compare_digests", "IntegrityCallback"]
 
 logger = logging.getLogger("paddle_tpu.resilience")
 
@@ -101,6 +102,63 @@ def tree_fingerprint(tree, prefix=""):
                 visit(f"{path}/{i}" if path else str(i), v)
         elif node is None:
             return
+        elif hasattr(node, "dtype") or hasattr(node, "__array__"):
+            out[path] = _leaf_crc(node)
+        else:
+            out[path] = zlib.crc32(repr(node).encode())
+
+    visit(prefix, tree)
+    return out
+
+
+def shard_fingerprint(tree, prefix="", devices=None):
+    """Per-ADDRESSABLE-shard CRC32 digest of a (possibly GSPMD-sharded)
+    tree: ``{leaf_path@window: crc32}`` where ``window`` names the
+    shard's global index slice (``0:64,32:64``).
+
+    The multi-chip view of :func:`tree_fingerprint`: under real GSPMD
+    a rank holds only its addressable shards, so the digest covers
+    exactly the bytes this rank owns — no device→host gather of the
+    global array.  Duplicate windows (axes replicated across local
+    devices) hash once.  ``devices`` restricts the view to shards on
+    those devices (how tests simulate per-rank locality on one host).
+
+    Cross-rank comparison contract: digests are only comparable within
+    a dp REPLICA GROUP (``distributed.mesh.replica_peers``) — mp/pp/
+    sharding neighbours hold *different* windows and legitimately
+    differ; comparing across them is a false positive by construction.
+    """
+    out = {}
+    devset = None if devices is None else set(devices)
+
+    def win_key(index, shape):
+        return ",".join(
+            f"{sl.start or 0}:{shape[i] if sl.stop is None else sl.stop}"
+            for i, sl in enumerate(index))
+
+    def visit(path, node):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                visit(f"{path}/{k}" if path else str(k), node[k])
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                visit(f"{path}/{i}" if path else str(i), v)
+        elif node is None:
+            return
+        elif getattr(node, "addressable_shards", None):
+            seen = set()
+            for sh in node.addressable_shards:
+                if devset is not None and sh.device not in devset:
+                    continue
+                index = tuple(
+                    sl if isinstance(sl, slice) else slice(sl, sl + 1)
+                    for sl in (sh.index or
+                               (slice(0, 1),) * max(node.ndim, 1)))
+                key = win_key(index, tuple(node.shape) or (1,))
+                if key in seen:
+                    continue
+                seen.add(key)
+                out[f"{path}@{key}"] = _leaf_crc(sh.data)
         elif hasattr(node, "dtype") or hasattr(node, "__array__"):
             out[path] = _leaf_crc(node)
         else:
@@ -194,11 +252,27 @@ class IntegrityCallback(TrainingCallback):
     def __init__(self, store=None, rank=0, world_size=1,
                  fingerprint_every=25, replay_every=0, monitor=None,
                  include_opt_state=False, key_prefix="integrity",
-                 history=4, registry=None, tracer=None, clock=None):
+                 history=4, registry=None, tracer=None, clock=None,
+                 peers=None, fingerprint_shards=False,
+                 local_devices=None):
+        """``peers``/``fingerprint_shards``/``local_devices`` are the
+        GSPMD wiring: under a multi-chip mesh the fingerprint must
+        cover each rank's *addressable shard view*
+        (:func:`shard_fingerprint`, enabled by ``fingerprint_shards``;
+        ``local_devices`` restricts to this rank's devices) and the
+        cross-rank compare must be restricted to this rank's dp
+        replica group (``peers``, from
+        :func:`~paddle_tpu.distributed.mesh.replica_peers`) — mp/pp/
+        sharding neighbours hold different shards and legitimately
+        differ."""
         super().__init__()
         self.store = store
         self.rank = int(rank)
         self.world_size = int(world_size)
+        self.peers = None if peers is None else sorted(
+            int(p) for p in peers)
+        self.fingerprint_shards = bool(fingerprint_shards)
+        self.local_devices = local_devices
         self.fingerprint_every = int(fingerprint_every)
         self.replay_every = int(replay_every)
         self.monitor = monitor
@@ -372,7 +446,11 @@ class IntegrityCallback(TrainingCallback):
 
     def _run_fingerprint(self, step):
         t0 = time.perf_counter()
-        digest = tree_fingerprint(self._fingerprint_tree())
+        if self.fingerprint_shards:
+            digest = shard_fingerprint(self._fingerprint_tree(),
+                                       devices=self.local_devices)
+        else:
+            digest = tree_fingerprint(self._fingerprint_tree())
         self.registry().histogram(
             "integrity_fingerprint_seconds",
             "wall time of one parameter-tree fingerprint").observe(
@@ -421,9 +499,13 @@ class IntegrityCallback(TrainingCallback):
     def _peer_digests(self):
         """Peer fingerprints for THIS global step — only ranks that
         have already published (non-blocking: a slow peer is compared
-        on a later step, not waited on)."""
+        on a later step, not waited on).  With ``peers`` set, only the
+        dp replica group is consulted — everyone else's shard view
+        differs by construction."""
         out = {}
-        for r in range(self.world_size):
+        ranks = (self.peers if self.peers is not None
+                 else range(self.world_size))
+        for r in ranks:
             if r == self.rank:
                 continue
             key = _rank_step_key(self.key_prefix, r, self._global_step)

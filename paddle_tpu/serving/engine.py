@@ -204,7 +204,7 @@ class Engine:
                  default_ttl_s=None, shed_occupancy_high=None,
                  shed_occupancy_low=None, shed_queue_high=None,
                  shed_queue_low=None, drain_floor_s=None,
-                 clock=None, tracer=None):
+                 clock=None, tracer=None, mesh=None):
         self.cfg = cfg
         self._clock = clock or time.perf_counter
         if tracer is None:
@@ -258,11 +258,40 @@ class Engine:
                                    qlens, ctxs, k_pages, v_pages, tables,
                                    max_q=max_q)
 
+        # GSPMD serving (prepare(mesh=...) analogue): params follow the
+        # mesh.py GPT rule table and the KV page pool [L, P, ps, H, hd]
+        # shards its HEAD axis along "mp" — each model-parallel shard
+        # owns its head group's pages, so page writes are local and the
+        # only cross-shard traffic is the per-layer psum GSPMD inserts
+        # at the residual write plus ONE logits gather per step
+        # (out_shardings pins logits replicated; pages stay sharded
+        # end-to-end, never gathered).
+        self.mesh = mesh
+        self._page_sharding = None
+        jit_kw = {"donate_argnums": donate}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..distributed import mesh as mesh_mod
+
+            self.params = mesh_mod.shard_params(self.params, mesh)
+            page_spec = mesh_mod.resolve_spec(
+                P(None, None, None, "mp"), self.cache.k_pages.shape,
+                mesh)
+            psh = NamedSharding(mesh, page_spec)
+            self.cache.k_pages = jax.device_put(self.cache.k_pages, psh)
+            self.cache.v_pages = jax.device_put(self.cache.v_pages, psh)
+            self._page_sharding = psh
+            rep = NamedSharding(mesh, P())
+            p_sh = mesh_mod.sharding_tree(self.params, mesh)
+            jit_kw.update(
+                in_shardings=(p_sh, psh, psh) + (rep,) * 6,
+                out_shardings=(rep, psh, psh))
         # watchdog-wrapped: the ONE statically-shaped program — prompt
         # chunks and decode rows share it — must compile exactly once;
         # any recompile here is a serving bug the watchdog flags with
         # the offending shape diff
-        self._step_fn = watch(jax.jit(_step, donate_argnums=donate),
+        self._step_fn = watch(jax.jit(_step, **jit_kw),
                               name="serving::unified_step")
 
     # ------------------------------------------------------------- submit

@@ -17,6 +17,11 @@ import jax
 # the axon TPU plugin ignores JAX_PLATFORMS env; the config knob wins
 jax.config.update("jax_platforms", "cpu")
 
+# jax compat shim (jax.shard_map on experimental-only builds) — must be
+# in place before test modules do `from jax import shard_map` at import
+# time, which can precede their paddle_tpu import
+import paddle_tpu  # noqa: E402,F401
+
 # persistent compilation cache: repeat suite runs skip XLA recompiles
 # (reference quarantines slow tests via tools/parallel_UT_rule.py; our
 # equivalent is @pytest.mark.slow + this cache)

@@ -1,0 +1,237 @@
+"""Multi-host checkpoint commit barrier — globally-consistent latest().
+
+Extends the PR 3/PR 6 kill-at-every-boundary matrix across HOSTS: ranks
+run as threads sharing one checkpoint directory (the shared-filesystem
+model) and one TCPStore, each with its own client + CommitBarrier.  The
+invariant under every fault: ``latest()`` moves on ALL ranks or on
+NONE — a rank killed before its shard ack (``checkpoint.shard_ack``)
+or a committer killed pre-rename (``checkpoint.before_barrier_commit``)
+must leave every survivor resolving the PREVIOUS checkpoint.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.checkpoint import (CommitBarrier,
+                                               CommitBarrierError,
+                                               load_sharded,
+                                               save_sharded)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.resilience.checkpoint_manager import CheckpointManager
+from paddle_tpu.resilience.faults import (FaultSpec, SimulatedCrash,
+                                          injected_faults)
+
+WORLD = 2
+
+
+@pytest.fixture
+def master_store():
+    store = TCPStore(is_master=True, world_size=WORLD)
+    yield store
+
+
+def _client(master):
+    return TCPStore(port=master.port, world_size=WORLD)
+
+
+def _tree(step):
+    return {"w": np.arange(16.0) + step, "b": np.full((4,), float(step))}
+
+
+def _run_ranks(fn, world=WORLD):
+    """Run fn(rank) on one thread per rank; returns {rank: outcome}
+    where outcome is ("ok", value) or (ExceptionName, value)."""
+    results = {}
+
+    def wrap(r):
+        try:
+            results[r] = ("ok", fn(r))
+        except BaseException as e:     # noqa: BLE001 - SimulatedCrash IS the point
+            results[r] = (type(e).__name__, None)
+
+    threads = [threading.Thread(target=wrap, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    return results
+
+
+# ------------------------------------------------------- happy protocol
+
+
+class TestBarrierProtocol:
+    def test_all_ranks_commit_and_agree(self, master_store, tmp_path):
+        d = str(tmp_path / "ck")
+
+        def rank(r):
+            mgr = CheckpointManager(
+                d, barrier=CommitBarrier(_client(master_store), r,
+                                         WORLD, timeout=10.0))
+            mgr.save(_tree(1), 1)
+            return mgr.latest()
+
+        results = _run_ranks(rank)
+        assert results == {0: ("ok", 1), 1: ("ok", 1)}
+        # both ranks' manifests landed under the committed step dir
+        step_dir = os.path.join(d, "step_0000000001")
+        names = sorted(os.listdir(step_dir))
+        assert "manifest.0.json" in names and "manifest.1.json" in names
+
+    def test_bare_save_sharded_barrier_commit(self, master_store,
+                                              tmp_path):
+        """Manifest-level commit (no manager): pending manifests become
+        visible only after rank 0's barrier rename."""
+        d = str(tmp_path / "raw")
+
+        def rank(r):
+            save_sharded(d, _tree(3), step=3,
+                         barrier=CommitBarrier(_client(master_store), r,
+                                               WORLD, timeout=10.0))
+            return True
+
+        results = _run_ranks(rank)
+        assert all(v == ("ok", True) for v in results.values())
+        host, manifest = load_sharded(d)
+        assert manifest["step"] == 3
+        np.testing.assert_array_equal(host["w"], _tree(3)["w"])
+        assert not [n for n in os.listdir(d) if n.endswith(".pending")]
+
+    def test_retry_after_failed_attempt_uses_new_generation(
+            self, master_store, tmp_path):
+        """A dead attempt's stale acks must not satisfy a retried save
+        of the SAME step (generation-qualified keys)."""
+        d = str(tmp_path / "ck")
+
+        # attempt 1: rank 1 never shows up -> rank 0 times out
+        def lone(r):
+            mgr = CheckpointManager(
+                d, barrier=CommitBarrier(_client(master_store), r,
+                                         WORLD, timeout=1.0))
+            mgr.save(_tree(1), 1)
+
+        results = _run_ranks(lone, world=1)
+        assert results[0][0] == "CommitBarrierError"
+        mgr = CheckpointManager(d, sweep_orphans=False)
+        assert mgr.latest() is None
+
+        # attempt 2, same step: both ranks -> commits cleanly
+        def rank(r):
+            mgr = CheckpointManager(
+                d, barrier=CommitBarrier(_client(master_store), r,
+                                         WORLD, timeout=10.0))
+            mgr.save(_tree(1), 1)
+            return mgr.latest()
+
+        results = _run_ranks(rank)
+        assert results == {0: ("ok", 1), 1: ("ok", 1)}
+
+
+# ------------------------------------------------------- the kill matrix
+
+
+class TestCommitBarrierKillMatrix:
+    def _committed_step_then(self, master_store, d, faults, timeout=2.0):
+        """Commit step 1 cleanly, then attempt step 2 under ``faults``;
+        returns the per-rank outcomes of attempt 2."""
+
+        def save_step(r, step, t):
+            mgr = CheckpointManager(
+                d, barrier=CommitBarrier(_client(master_store), r,
+                                         WORLD, timeout=t))
+            mgr.save(_tree(step), step)
+            return mgr.latest()
+
+        results = _run_ranks(lambda r: save_step(r, 1, 10.0))
+        assert results == {0: ("ok", 1), 1: ("ok", 1)}
+        with injected_faults(*faults):
+            return _run_ranks(lambda r: save_step(r, 2, timeout))
+
+    def test_rank_killed_before_ack_never_advances_latest(
+            self, master_store, tmp_path):
+        """THE acceptance case: one rank dies at checkpoint.shard_ack
+        before publishing its CRCs — the barrier starves, nothing is
+        renamed, and latest() on every surviving rank (and for any
+        later reader) is still the PREVIOUS step."""
+        d = str(tmp_path / "ck")
+        results = self._committed_step_then(
+            master_store, d,
+            [FaultSpec("checkpoint.shard_ack", "kill", occurrence=1)])
+        outcomes = sorted(kind for kind, _ in results.values())
+        assert outcomes == ["CommitBarrierError", "SimulatedCrash"]
+        reader = CheckpointManager(d, sweep_orphans=False)
+        assert reader.latest() == 1
+        _, tree, manifest = reader.restore()
+        assert manifest["step"] == 1
+        np.testing.assert_array_equal(tree["w"], _tree(1)["w"])
+
+    def test_committer_killed_before_barrier_commit(self, master_store,
+                                                    tmp_path):
+        """Rank 0 collects every ack then dies at
+        checkpoint.before_barrier_commit — still nothing renamed, every
+        survivor times out, latest() == previous everywhere."""
+        d = str(tmp_path / "ck")
+        results = self._committed_step_then(
+            master_store, d,
+            [FaultSpec("checkpoint.before_barrier_commit", "kill",
+                       occurrence=1)])
+        kinds = {r: kind for r, (kind, _) in results.items()}
+        assert kinds[0] == "SimulatedCrash"
+        assert kinds[1] == "CommitBarrierError"
+        assert CheckpointManager(d, sweep_orphans=False).latest() == 1
+
+    def test_ack_stall_is_tolerated_within_timeout(self, master_store,
+                                                   tmp_path):
+        """A SLOW rank (stall at checkpoint.shard_ack) is not a dead
+        rank: the barrier waits it out and the commit completes on
+        every rank."""
+        d = str(tmp_path / "ck")
+        results = self._committed_step_then(
+            master_store, d,
+            [FaultSpec("checkpoint.shard_ack", "stall", occurrence=1,
+                       stall_s=0.3)],
+            timeout=10.0)
+        assert results == {0: ("ok", 2), 1: ("ok", 2)}
+        assert CheckpointManager(d, sweep_orphans=False).latest() == 2
+
+    def test_crashed_attempt_resumes_from_previous_everywhere(
+            self, master_store, tmp_path):
+        """After the failed step-2 attempt, a relaunched fleet retries
+        step 2 and every rank converges on it (the tmp debris of the
+        dead attempt is swept by rank 0's next begin())."""
+        d = str(tmp_path / "ck")
+        self._committed_step_then(
+            master_store, d,
+            [FaultSpec("checkpoint.shard_ack", "kill", occurrence=1)])
+
+        def rank(r):
+            mgr = CheckpointManager(
+                d, barrier=CommitBarrier(_client(master_store), r,
+                                         WORLD, timeout=10.0))
+            mgr.save(_tree(2), 2)
+            return mgr.latest()
+
+        results = _run_ranks(rank)
+        assert results == {0: ("ok", 2), 1: ("ok", 2)}
+        _, tree, _ = CheckpointManager(d, sweep_orphans=False).restore()
+        np.testing.assert_array_equal(tree["w"], _tree(2)["w"])
+
+
+class TestBarrierIntrospection:
+    def test_status_snapshot(self, master_store, tmp_path):
+        def rank(r):
+            b = CommitBarrier(_client(master_store), r, WORLD,
+                              timeout=10.0)
+            mgr = CheckpointManager(str(tmp_path / "ck"), barrier=b)
+            mgr.save(_tree(1), 1)
+            return b.status()
+
+        results = _run_ranks(rank)
+        st0 = results[0][1]
+        assert st0["tokens"] == {"step_0000000001": "committed"}
+        assert st0["acked_ranks"] == {"step_0000000001": [0, 1]}
+        assert results[1][1]["tokens"] == {
+            "step_0000000001": "committed"}

@@ -26,6 +26,7 @@ from paddle_tpu.resilience.faults import fault_point
 from paddle_tpu.resilience.integrity import (compare_digests,
                                              first_divergent_leaf,
                                              majority_partition,
+                                             shard_fingerprint,
                                              tree_fingerprint)
 
 REPO = os.path.join(os.path.dirname(__file__), os.pardir)
@@ -95,6 +96,109 @@ class TestTreeFingerprint:
         assert rep["divergent_ranks"] == [1]
         assert rep["majority_ranks"] == [0, 2]
         assert rep["first_divergent_leaf"] == {1: "w"}
+
+
+class TestShardFingerprint:
+    """GSPMD shard-view fingerprints on a 2x2 (dp x mp) mesh — the
+    multi-chip regression the ROADMAP asked for: the sentinel digests
+    each rank's ADDRESSABLE shards and compares only within dp replica
+    groups (mp peers hold different windows and legitimately differ)."""
+
+    def _mesh_tree(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from paddle_tpu.distributed import mesh as mesh_mod
+
+        mesh = mesh_mod.build_mesh(dp=2, mp=2)
+        w = jax.device_put(
+            np.arange(64, dtype=np.float32).reshape(8, 8),
+            NamedSharding(mesh, P(None, "mp")))
+        g = jax.device_put(np.ones(4, np.float32),
+                           NamedSharding(mesh, P()))
+        return mesh, {"w": w, "g": g}
+
+    def _rank_devices(self, mesh):
+        """One simulated process per mesh device of the (dp, mp) grid,
+        rank = dp_idx * mp + mp_idx (build_mesh's row-major layout)."""
+        grid = mesh.devices.reshape(2, 2)
+        return {d * 2 + m: [grid[d, m]] for d in range(2)
+                for m in range(2)}
+
+    def test_window_keys_and_dedup(self):
+        mesh, tree = self._mesh_tree()
+        fp = shard_fingerprint(tree)
+        # w: 2 distinct mp windows (dp replicas dedup); g: 1 window
+        assert set(fp) == {"w@0:8,0:4", "w@0:8,4:8", "g@0:4"}
+        assert fp == shard_fingerprint(tree)
+
+    def test_dp_replicas_match_mp_peers_differ(self):
+        from paddle_tpu.distributed.mesh import replica_peers
+
+        mesh, tree = self._mesh_tree()
+        devs = self._rank_devices(mesh)
+        digests = {r: shard_fingerprint(tree, devices=devs[r])
+                   for r in range(4)}
+        # dp replicas (ranks differing only in dp coord) are bitwise
+        # identical; mp neighbours hold DIFFERENT windows
+        axes = {"dp": 2, "mp": 2}
+        assert replica_peers(0, axes) == [0, 2]
+        assert digests[0] == digests[2]
+        assert digests[1] == digests[3]
+        assert digests[0] != digests[1]
+        # restricted to the dp replica group: no divergence
+        assert compare_digests({r: digests[r]
+                                for r in replica_peers(0, axes)}) is None
+        assert compare_digests({r: digests[r]
+                                for r in replica_peers(1, axes)}) is None
+        # the naive all-ranks compare would false-positive — exactly
+        # why the callback takes peers=
+        assert compare_digests(digests) is not None
+
+    def test_corrupt_shard_detected_within_replica_group(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh, tree = self._mesh_tree()
+        devs = self._rank_devices(mesh)
+        bad = np.asarray(tree["w"]).copy()
+        bad[3, 5] += 1e-3                    # lands in the mp=1 window
+        tree_bad = {"w": jax.device_put(
+            bad, NamedSharding(mesh, P(None, "mp"))), "g": tree["g"]}
+        # rank 3 (dp=1, mp=1) computes from the corrupted state
+        digests = {1: shard_fingerprint(tree, devices=devs[1]),
+                   3: shard_fingerprint(tree_bad, devices=devs[3])}
+        rep = compare_digests(digests)
+        assert rep is not None
+        leaf = list(rep["first_divergent_leaf"].values())[0]
+        assert leaf == "w@0:8,4:8"           # names the exact window
+
+    def test_callback_peers_restriction(self):
+        """IntegrityCallback wired for the 2x2 mesh: rank 1 publishes
+        its mp=1 shard view; rank 3 (its dp replica) sees a match while
+        rank 0's digest — present in the store — is never consulted."""
+        from paddle_tpu.hapi import IntegrityCallback
+
+        mesh, tree = self._mesh_tree()
+        devs = self._rank_devices(mesh)
+        store = TCPStore(is_master=True, world_size=1)
+        cbs = {}
+        for r in (0, 1, 3):
+            cb = IntegrityCallback(
+                store=store, rank=r, world_size=4,
+                fingerprint_every=1, peers=[r % 2, r % 2 + 2],
+                fingerprint_shards=True, local_devices=devs[r],
+                registry=MetricsRegistry())
+            cb._fingerprint_tree = (
+                lambda t=tree, rr=r: {"params": t})   # bypass model
+            cb.model = None
+            cbs[r] = cb
+        for r in (0, 1, 3):
+            cbs[r]._global_step = 1
+            cbs[r]._run_fingerprint(step=0)
+        assert cbs[3].divergence_active is False
+        assert cbs[3].last_verified_global_step == 1
+        assert cbs[1].events == [] and cbs[3].events == []
 
 
 # --------------------------------------------------------- bitflip fault

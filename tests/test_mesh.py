@@ -1,0 +1,370 @@
+"""Real GSPMD multi-chip execution over ``distributed.mesh``.
+
+The promotion of multi-chip from the 8-way dry-run to REAL sharded
+execution: every test here runs actual jitted programs on the 8 virtual
+host devices the conftest forces, and placement is asserted against
+``addressable_shards`` — what the devices actually hold, not what a
+spec requested.  Covers: mesh construction/validation, the GPT
+PartitionSpec rule table with per-leaf divisibility pruning, ZeRO
+optimizer-state sharding, the dp=2 x mp=4 hapi train-step loss parity
+vs single device, sharded eval, and mp-sharded serving greedy decode
+token parity with the page pool living sharded end-to-end.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_init
+
+CFG = GPTConfig(vocab_size=512, max_seq_len=64, hidden=64, num_layers=2,
+                num_heads=4, ffn_hidden=256, dtype="float32",
+                use_flash=False, remat="nothing")
+
+
+def _ce_loss(out, y):
+    from paddle_tpu.core.tensor import Tensor
+
+    logits = (out.data if isinstance(out, Tensor) else out)
+    logits = logits.astype(jnp.float32)
+    yv = y.data if isinstance(y, Tensor) else y
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, yv[..., None], axis=-1)[..., 0]
+    return -picked.mean()
+
+
+# ---------------------------------------------------------- construction
+
+
+class TestBuildMesh:
+    def test_axes_and_order(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        assert mesh.axis_names == mesh_mod.AXIS_ORDER
+        assert mesh_mod.axis_sizes(mesh) == {
+            "dp": 2, "mp": 4, "pp": 1, "sharding": 1}
+        assert mesh_mod.mesh_axis(mesh, "mp") == 4
+        assert mesh_mod.mesh_axis(mesh, "nope") == 1
+
+    def test_validates_device_count(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            mesh_mod.build_mesh(dp=4, mp=4)
+        with pytest.raises(ValueError, match="must be >= 1"):
+            mesh_mod.build_mesh(dp=0)
+
+    def test_subset_of_devices(self):
+        mesh = mesh_mod.build_mesh(mp=4)
+        assert mesh.devices.size == 4
+
+    def test_default_mesh_roundtrip(self):
+        assert mesh_mod.default_mesh() is None
+        m = mesh_mod.build_mesh(dp=2)
+        try:
+            assert mesh_mod.set_default_mesh(m) is m
+            assert mesh_mod.default_mesh() is m
+        finally:
+            mesh_mod.set_default_mesh(None)
+
+
+# ------------------------------------------------------------ rule table
+
+
+class TestRuleTable:
+    def test_gpt_specs(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        specs = mesh_mod.param_specs(gpt_init(CFG), mesh)
+        assert specs["wte"] == P("mp", None)
+        assert specs["blocks"]["qkv_w"] == P(None, None, "mp")
+        assert specs["blocks"]["proj_w"] == P(None, "mp", None)
+        assert specs["blocks"]["up_w"] == P(None, None, "mp")
+        assert specs["blocks"]["down_w"] == P(None, "mp", None)
+        # norms replicated
+        assert specs["blocks"]["ln1_g"] == P(None, None)
+        assert specs["lnf_g"] == P(None)
+
+    def test_flat_names_hit_same_rules(self):
+        """hapi flattens blocks/qkv_w -> blocks_qkv_w; same rule."""
+        mesh = mesh_mod.build_mesh(mp=4)
+        flat = {"blocks_qkv_w": np.zeros((2, 64, 192)),
+                "wte": np.zeros((512, 64)),
+                "blocks_ln1_g": np.zeros((2, 64))}
+        specs = mesh_mod.param_specs(flat, mesh)
+        assert specs["blocks_qkv_w"] == P(None, None, "mp")
+        assert specs["wte"] == P("mp", None)
+        assert specs["blocks_ln1_g"] == P(None, None)
+
+    def test_indivisible_dim_degrades_to_replication(self):
+        mesh = mesh_mod.build_mesh(mp=4)
+        # 6 % 4 != 0: the mp split is pruned, not an error
+        assert mesh_mod.resolve_spec(P(None, "mp"), (8, 6), mesh) == \
+            P(None, None)
+        assert mesh_mod.resolve_spec(P("mp"), (8,), mesh) == P("mp")
+
+    def test_unknown_leaf_replicates(self):
+        mesh = mesh_mod.build_mesh(mp=4)
+        specs = mesh_mod.param_specs({"custom_thing": np.zeros((8, 8))},
+                                     mesh)
+        assert specs["custom_thing"] == P(None, None)
+
+    def test_extra_rules_override(self):
+        mesh = mesh_mod.build_mesh(mp=4)
+        specs = mesh_mod.param_specs(
+            {"custom_thing": np.zeros((8, 8))}, mesh,
+            extra_rules=((r"custom_thing$", P(None, "mp")),))
+        assert specs["custom_thing"] == P(None, "mp")
+
+
+# ----------------------------------------------------- actual placement
+
+
+class TestPlacement:
+    def test_shard_params_addressable_shards(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        params = mesh_mod.shard_params(gpt_init(CFG), mesh)
+        qkv = params["blocks"]["qkv_w"]
+        # 8 local devices, 4 distinct windows (mp tiles), dp repeats them
+        assert len(qkv.addressable_shards) == 8
+        windows = {tuple((s.start, s.stop) for s in sh.index)
+                   for sh in qkv.addressable_shards}
+        assert len(windows) == 4
+        assert qkv.addressable_shards[0].data.shape == (2, 64, 48)
+        mesh_mod.assert_placement(qkv, mesh, P(None, None, "mp"),
+                                  "qkv_w")
+        mesh_mod.assert_placement(params["wte"], mesh, P("mp", None),
+                                  "wte")
+        mesh_mod.assert_placement(params["lnf_g"], mesh, P(), "lnf_g")
+
+    def test_assert_placement_catches_wrong_layout(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        rep = jax.device_put(np.zeros((8, 8)), mesh_mod.replicated(mesh))
+        with pytest.raises(AssertionError, match="shard shape"):
+            mesh_mod.assert_placement(rep, mesh, P("mp", None), "w")
+
+    def test_shard_batch(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        x, y = mesh_mod.shard_batch(mesh, np.zeros((8, 16)),
+                                    np.zeros((8,), np.int32))
+        assert x.sharding.spec == P("dp", None)
+        assert y.sharding.spec == P("dp")
+        # a batch the dp degree doesn't divide replicates, never dies
+        z = mesh_mod.shard_batch(mesh, np.zeros((3, 4)))
+        assert z.sharding.spec == P(None, None)
+
+    def test_placement_report(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        params = mesh_mod.shard_params(gpt_init(CFG), mesh)
+        rep = mesh_mod.placement_report(
+            {"qkv_w": params["blocks"]["qkv_w"], "host": np.zeros(3)})
+        assert rep["qkv_w"]["distinct_windows"] == 4
+        assert rep["qkv_w"]["devices"] == 8
+        assert rep["qkv_w"]["spec"] == [None, None, "mp"]
+        assert rep["host"]["devices"] == 1
+
+
+# ------------------------------------------------------------- ZeRO opt
+
+
+class TestZeroOptSharding:
+    def test_slots_pick_up_sharding_axis(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=2, sharding=2)
+        params = gpt_init(CFG)
+        pspecs = mesh_mod.param_specs(params, mesh)
+        slots = {k: {"moment1": np.zeros_like(v), "moment2":
+                     np.zeros_like(v)}
+                 for k, v in params["blocks"].items()}
+        ospecs = mesh_mod.zero_opt_specs(pspecs["blocks"], slots, mesh)
+        # qkv_w [L, D, 3D]: mp on dim 2, largest free dim (D=64) gets
+        # the sharding split
+        assert ospecs["qkv_w"]["moment1"] == P(None, "sharding", "mp")
+        assert ospecs["qkv_w"]["moment2"] == P(None, "sharding", "mp")
+        # replicated norm slots spread too (dim 1 = D divides)
+        assert ospecs["ln1_g"]["moment1"] == P(None, "sharding")
+
+    def test_no_sharding_axis_keeps_param_spec(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        specs = mesh_mod.zero_opt_specs(
+            {"w": P(None, "mp")}, {"w": {"m": np.zeros((8, 8))}}, mesh)
+        assert specs["w"]["m"] == P(None, "mp")
+
+    def test_scalar_slots_replicate(self):
+        mesh = mesh_mod.build_mesh(sharding=8)
+        specs = mesh_mod.zero_opt_specs(
+            {"w": P()}, {"w": {"count": np.zeros(())}}, mesh)
+        assert specs["w"]["count"] == P()
+
+
+# --------------------------------------------------------- replica peers
+
+
+class TestReplicaPeers:
+    def test_dp_groups_on_2x4(self):
+        axes = {"dp": 2, "mp": 4}
+        # rank = dp_idx * 4 + mp_idx; dp replicas share mp_idx
+        assert mesh_mod.replica_peers(0, axes) == [0, 4]
+        assert mesh_mod.replica_peers(5, axes) == [1, 5]
+        assert mesh_mod.replica_peers(3, axes) == [3, 7]
+
+    def test_three_axis_grid(self):
+        axes = {"dp": 2, "mp": 2, "sharding": 2}
+        assert mesh_mod.replica_peers(0, axes) == [0, 4]
+        assert mesh_mod.replica_peers(7, axes) == [3, 7]
+        assert mesh_mod.replica_peers(2, axes, axis="sharding") == [2, 3]
+
+    def test_validates(self):
+        with pytest.raises(ValueError, match="outside world"):
+            mesh_mod.replica_peers(8, {"dp": 2, "mp": 4})
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            mesh_mod.replica_peers(0, {"dp": 2}, axis="bogus")
+
+
+# ----------------------------------------------- hapi GSPMD train steps
+
+
+def _fit_manual(mesh, n_steps=10, lr=1e-3):
+    """n_steps of Model.train_batch on a tiny GPT under ``mesh``;
+    returns (model, losses)."""
+    import paddle_tpu
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.optimizer.optimizers import Adam
+
+    paddle_tpu.seed(7)
+    net = GPT(CFG)
+    m = Model(net).prepare(optimizer=Adam(learning_rate=lr),
+                           loss=_ce_loss, device_mesh=mesh)
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(n_steps):
+        x = rng.randint(0, CFG.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.randint(0, CFG.vocab_size, (8, 16)).astype(np.int32)
+        loss, _ = m.train_batch([x], [y])
+        losses.append(loss)
+    return m, losses
+
+
+class TestHapiGSPMD:
+    def test_dp2_mp4_loss_parity_10_steps(self):
+        """THE acceptance run: a real dp=2 x mp=4 GSPMD train step on 8
+        host devices tracks the single-device loss curve for 10 steps
+        within 1e-4 — and params / optimizer slots actually LIVE
+        sharded between steps (addressable_shards, not dry-run specs)."""
+        _, ref = _fit_manual(None)
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        m, got = _fit_manual(mesh)
+        np.testing.assert_allclose(got, ref, rtol=0, atol=1e-4)
+        assert all(np.isfinite(got))
+        named = dict(m.network.named_parameters())
+        mesh_mod.assert_placement(named["blocks_qkv_w"].data, mesh,
+                                  P(None, None, "mp"), "qkv_w")
+        mesh_mod.assert_placement(named["wte"].data, mesh,
+                                  P("mp", None), "wte")
+        for slot in m._opt_state["slots"]["blocks_qkv_w"].values():
+            mesh_mod.assert_placement(slot, mesh, P(None, None, "mp"),
+                                      "qkv slot")
+
+    def test_zero_sharded_opt_state_parity(self):
+        """dp=2 x mp=2 x sharding=2: optimizer slots spread over the
+        sharding axis (ZeRO) while the loss curve still matches."""
+        _, ref = _fit_manual(None, n_steps=6)
+        mesh = mesh_mod.build_mesh(dp=2, mp=2, sharding=2)
+        m, got = _fit_manual(mesh, n_steps=6)
+        np.testing.assert_allclose(got, ref, rtol=0, atol=1e-4)
+        slot = m._opt_state["slots"]["blocks_qkv_w"]["moment1"]
+        # param spec (None, None, mp) + sharding on the largest free dim
+        mesh_mod.assert_placement(slot, mesh, P(None, "sharding", "mp"),
+                                  "moment1")
+
+    def test_sharded_eval_step(self):
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        m, _ = _fit_manual(mesh, n_steps=2)
+        m_ref, _ = _fit_manual(None, n_steps=2)
+        rng = np.random.RandomState(11)
+        x = rng.randint(0, CFG.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.randint(0, CFG.vocab_size, (8, 16)).astype(np.int32)
+        loss, _ = m.eval_batch([x], [y])
+        ref_loss, _ = m_ref.eval_batch([x], [y])
+        assert abs(loss - ref_loss) < 1e-4
+
+    def test_auto_mesh_is_pure_dp(self):
+        from paddle_tpu.hapi.model import Model
+        from paddle_tpu.optimizer.optimizers import Adam
+
+        net = GPT(CFG)
+        m = Model(net).prepare(optimizer=Adam(learning_rate=1e-3),
+                               loss=_ce_loss, device_mesh="auto")
+        assert mesh_mod.axis_sizes(m._mesh)["dp"] == len(jax.devices())
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, CFG.vocab_size, (8, 16)).astype(np.int32)
+        y = rng.randint(0, CFG.vocab_size, (8, 16)).astype(np.int32)
+        loss, _ = m.train_batch([x], [y])
+        assert np.isfinite(loss)
+        # pure dp: params replicated on all 8 devices, batch split
+        named = dict(m.network.named_parameters())
+        mesh_mod.assert_placement(named["blocks_qkv_w"].data, m._mesh,
+                                  P(), "qkv_w")
+
+
+# ------------------------------------------------- mp-sharded serving
+
+
+class TestServingGSPMD:
+    def _prompts(self, n=4):
+        return [list(np.random.RandomState(i).randint(
+            1, CFG.vocab_size - 1, 6 + i)) for i in range(n)]
+
+    def test_mp_sharded_greedy_token_identical(self):
+        """Serving acceptance: the mp=4-sharded engine (params split
+        per the rule table, KV page pool sharded on its head axis) is
+        token-identical to the unsharded engine — and the pages are
+        STILL sharded after generation (never gathered)."""
+        from paddle_tpu.serving.engine import Engine, SamplingParams
+
+        params = gpt_init(CFG, jax.random.key(0))
+        sp = SamplingParams(max_new_tokens=8)
+        prompts = self._prompts()
+        ref = Engine(CFG, params, page_size=8, num_pages=64,
+                     max_batch_size=4, chunk_len=16).generate(
+                         prompts, sp)
+        mesh = mesh_mod.build_mesh(mp=4)
+        eng = Engine(CFG, params, page_size=8, num_pages=64,
+                     max_batch_size=4, chunk_len=16, mesh=mesh)
+        page_spec = P(None, None, None, "mp")
+        mesh_mod.assert_placement(eng.cache.k_pages, mesh, page_spec,
+                                  "k_pages")
+        out = eng.generate(prompts, sp)
+        assert out == ref
+        mesh_mod.assert_placement(eng.cache.k_pages, mesh, page_spec,
+                                  "k_pages after decode")
+        mesh_mod.assert_placement(eng.cache.v_pages, mesh, page_spec,
+                                  "v_pages after decode")
+        mesh_mod.assert_placement(
+            eng.params["blocks"]["qkv_w"], mesh, P(None, None, "mp"),
+            "engine qkv_w")
+
+    def test_dp_mp_mesh_pages_shard_on_mp_only(self):
+        from paddle_tpu.serving.engine import Engine, SamplingParams
+
+        mesh = mesh_mod.build_mesh(dp=2, mp=4)
+        eng = Engine(CFG, gpt_init(CFG, jax.random.key(0)), page_size=8,
+                     num_pages=64, max_batch_size=2, chunk_len=16,
+                     mesh=mesh)
+        mesh_mod.assert_placement(eng.cache.k_pages, mesh,
+                                  P(None, None, None, "mp"), "k_pages")
+        out = eng.generate(self._prompts(2),
+                           SamplingParams(max_new_tokens=4))
+        assert all(len(o) == 4 for o in out)
+
+    def test_mesh_engine_preemption_keeps_parity(self):
+        """Preemption-by-recompute under memory pressure must stay
+        token-identical when the pool is mp-sharded."""
+        from paddle_tpu.serving.engine import Engine, SamplingParams
+
+        params = gpt_init(CFG, jax.random.key(1))
+        sp = SamplingParams(max_new_tokens=6)
+        prompts = self._prompts(3)
+        kw = dict(page_size=4, num_pages=12, max_batch_size=3,
+                  chunk_len=8)
+        ref = Engine(CFG, params, **kw).generate(prompts, sp)
+        mesh = mesh_mod.build_mesh(mp=4)
+        assert Engine(CFG, params, mesh=mesh, **kw).generate(
+            prompts, sp) == ref
