@@ -868,6 +868,8 @@ def bench_lint(reps=3):
     one-pass parse cache is part of what is being measured.  The tier-1
     budget this must stay under is 10s."""
     from tools.analysis.core import Project, run_all
+    from tools.analysis.passes import (collective_discipline,
+                                       sharding_spec)
 
     walls, report = [], None
     for _ in range(reps):
@@ -875,6 +877,11 @@ def bench_lint(reps=3):
         report = run_all(Project())
         walls.append(time.perf_counter() - t0)
     wall_s = float(np.median(walls))
+    # coverage proof for the two SPMD passes: how much of the repo's
+    # collective plane / axis universe they actually see (an empty
+    # reach would make the clean run vacuous)
+    proj = Project()
+    sites = collective_discipline.collective_sites(proj)
     out = {
         "passes": len(report["passes"]),
         "files_scanned": report["files_scanned"],
@@ -884,6 +891,9 @@ def bench_lint(reps=3):
         "budget_seconds": 10.0,
         "per_pass_seconds": {rule: stats["seconds"]
                              for rule, stats in report["passes"].items()},
+        "collective_sites": len(sites),
+        "collective_site_files": len({s[0] for s in sites}),
+        "declared_mesh_axes": sharding_spec.declared_axes(proj),
     }
     log(f"[lint] {out['passes']} passes over {out['files_scanned']} "
         f"files in {wall_s:.2f}s (budget 10s), "

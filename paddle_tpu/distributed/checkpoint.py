@@ -32,7 +32,6 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 
 import numpy as np
 
@@ -41,6 +40,7 @@ import jax.numpy as jnp
 
 from ..resilience.atomic import atomic_write
 from ..resilience.faults import fault_point
+from ..resilience.retry import Deadline
 
 __all__ = ["save_sharded", "load_sharded", "save_engine_state",
            "load_engine_state", "CommitBarrier", "CommitBarrierError"]
@@ -110,11 +110,22 @@ class CommitBarrier:
             self.store.set(f"{self.key_prefix}/{token}/open",
                            str(gen))
         else:
-            deadline = time.monotonic() + self.timeout
-            while True:
-                remaining = max(0.05, deadline - time.monotonic())
-                raw = self.store.get(f"{self.key_prefix}/{token}/open",
-                                     blocking=True, timeout=remaining)
+            # ONE Deadline spans the whole join — the blocking get and
+            # the stale-generation re-poll share it, so a dead rank 0
+            # costs exactly self.timeout, never a stacked multiple,
+            # and the miss surfaces as a CommitBarrierError (the
+            # protocol's failure type), not a raw store timeout
+            dl = Deadline(self.timeout)
+            while True:   # lint-ok: bounded-retries Deadline-bounded poll
+                try:
+                    raw = self.store.get(
+                        f"{self.key_prefix}/{token}/open",
+                        blocking=True, timeout=dl.remaining())
+                except TimeoutError:
+                    raise CommitBarrierError(
+                        f"commit barrier {token!r}: rank 0 never "
+                        f"opened a generation within "
+                        f"{self.timeout}s") from None
                 gen = int(raw)
                 with self._lock:
                     stale = self._gen.get(token)
@@ -124,11 +135,11 @@ class CommitBarrier:
                 if (stale is None or gen > stale) \
                         and not self._finished(token, gen):
                     break
-                if time.monotonic() > deadline:   # lint-ok: bounded-retries deadline-bounded poll
+                if dl.expired():
                     raise CommitBarrierError(
                         f"commit barrier {token!r}: no new generation "
                         f"within {self.timeout}s (stuck at g{gen})")
-                time.sleep(0.005)
+                dl.sleep(0.005)
         with self._lock:
             self._gen[token] = gen
             self._state[token] = "open"
@@ -176,14 +187,18 @@ class CommitBarrier:
             self._state[token] = "acked"
 
     def _collect_acks(self, token, gen):
+        """Gather every rank's ack under ONE shared Deadline: each get
+        polls only the *remaining* budget (an expired deadline is one
+        non-blocking probe, then abort) — previously every straggler
+        after expiry still bought itself a fresh minimum wait, so a
+        wedged fleet overshot the timeout by O(world_size)."""
         acks = {}
-        deadline = time.monotonic() + self.timeout
+        dl = Deadline(self.timeout)
         for r in range(self.world_size):
-            remaining = max(0.05, deadline - time.monotonic())
             try:
                 raw = self.store.get(
                     self._key(token, gen, f"ack/rank_{r}"),
-                    blocking=True, timeout=remaining)
+                    blocking=True, timeout=dl.remaining())
             except (KeyError, TimeoutError):
                 self._abort(token, gen, f"rank {r} never acked")
                 raise CommitBarrierError(

@@ -23,6 +23,14 @@ are ops in the graph there); eager calls record real wall time.  The
 cross-rank hangs reproducible on CPU (``kind="stall"`` freezes a rank
 mid-collective with the record in flight — exactly what the
 :class:`~paddle_tpu.observability.flight.HangWatchdog` must localize).
+
+Every op here is *rank-uniform*: all participating ranks must reach it,
+in the same order, or the fleet wedges.  That contract is enforced
+statically by the ``collective-discipline`` pass (``python -m
+tools.analysis``): a call to any of these under a rank-conditional
+branch (``if rank == 0: all_reduce(...)``) is flagged at lint time as
+the hang the watchdog would otherwise only name at runtime;
+deliberately asymmetric protocols carry ``# rank-ok: <reason>``.
 """
 from __future__ import annotations
 

@@ -61,7 +61,12 @@ __all__ = ["AXIS_ORDER", "build_mesh", "axis_sizes", "mesh_axis",
 
 #: canonical logical-axis order; build_mesh lays devices out this way so
 #: dp-major iteration matches the (dp, mp, pp, sharding) process grid
-#: replica_peers() reasons over
+#: replica_peers() reasons over.  Also the anchor of the axis universe
+#: the ``sharding-spec`` static pass validates every PartitionSpec
+#: literal against (together with literal Mesh(...) axis tuples
+#: elsewhere in the package) — a typo'd axis never errors at runtime,
+#: resolve_spec just silently replicates, so the lint is the only
+#: thing that catches it before hardware
 AXIS_ORDER = ("dp", "mp", "pp", "sharding")
 
 _LOCK = threading.Lock()

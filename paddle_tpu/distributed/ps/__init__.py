@@ -210,7 +210,10 @@ class PSClient:
         prev = s.world_size
         s.world_size = world_size
         try:
-            s.barrier(name=name, timeout=timeout)
+            # resolve the default HERE so the forwarded budget is a
+            # real number, not a None that each layer re-defaults
+            s.barrier(name=name,
+                      timeout=s.timeout if timeout is None else timeout)
         finally:
             s.world_size = prev
 

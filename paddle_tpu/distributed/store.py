@@ -10,8 +10,6 @@ the coordination service — TCPStore exists for user-level coordination
 """
 from __future__ import annotations
 
-import time
-
 from ..native import load_tcp_store_lib
 from ..resilience.retry import Deadline, backoff_delays
 
@@ -80,11 +78,18 @@ class TCPStore:
         same store object (e.g. a heartbeat thread).  The poll backs off
         exponentially (1ms → 100ms cap, jittered) instead of spinning at
         a fixed 10ms — sub-ms latency for keys that are nearly there,
-        ~10 RPCs/s steady-state against a slow producer."""
+        ~10 RPCs/s steady-state against a slow producer.
+
+        ``timeout=None`` means the store's default budget; ``timeout``
+        <= 0 means ONE attempt then :class:`TimeoutError` (callers
+        passing an exhausted ``deadline.remaining()`` get a prompt
+        miss, not a silent promotion to the 30s default — the bug the
+        collective-discipline lint exists to keep out)."""
         import ctypes
 
         buf = ctypes.create_string_buffer(1 << 20)
-        deadline = time.time() + (timeout or self.timeout)
+        budget = self.timeout if timeout is None else float(timeout)
+        dl = Deadline(max(0.0, budget))
         delays = backoff_delays(base=0.001, cap=0.1)
         while True:
             n = self._lib.ts_get(self._client, key.encode(), buf, len(buf))
@@ -105,11 +110,11 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore.get({key!r}) failed rc={n}")
             if not blocking:
                 raise KeyError(key)
-            if time.time() > deadline:
+            if dl.expired():
                 raise TimeoutError(
                     f"TCPStore.get({key!r}) timed out after "
-                    f"{timeout or self.timeout}s")
-            time.sleep(min(next(delays), max(0.0, deadline - time.time())))
+                    f"{budget}s")
+            dl.sleep(next(delays))
 
     def add(self, key: str, delta: int = 1) -> int:
         import ctypes
@@ -125,8 +130,17 @@ class TCPStore:
         return int(out.value)
 
     def wait(self, keys, timeout=None):
+        """Block until every key exists, under ONE shared budget.
+
+        The total wait is bounded by ``timeout`` (default: the store's
+        budget) — each key's poll gets the *remaining* deadline, not a
+        fresh copy, so waiting on N slow keys costs one timeout, not
+        N of them (the fleet-size-scaling hazard the
+        collective-discipline lint flags)."""
+        dl = Deadline(self.timeout if timeout is None else
+                      float(timeout))
         for k in (keys if isinstance(keys, (list, tuple)) else [keys]):
-            self.get(k, blocking=True, timeout=timeout)
+            self.get(k, blocking=True, timeout=dl.remaining())
 
     def delete_key(self, key: str):
         self._lib.ts_delete(self._client, key.encode())
@@ -249,12 +263,16 @@ class TCPStore:
 
     # -------------------------------------------------------------- barrier
     def barrier(self, name="_barrier", timeout=None):
-        """Counter barrier over ``world_size`` participants."""
-        timeout = timeout or self.timeout
+        """Counter barrier over ``world_size`` participants.
+
+        ``timeout=None`` means the store's default; the ack-poll is
+        Deadline-bounded (monotonic — wall-clock steps can't extend or
+        expire it) and raises promptly once the budget is gone."""
+        budget = self.timeout if timeout is None else float(timeout)
         n = self.add(f"{name}/count", 1)
         gen = (n - 1) // self.world_size   # re-usable barrier generations
         target = (gen + 1) * self.world_size
-        deadline = time.time() + timeout
+        dl = Deadline(max(0.0, budget))
         delays = backoff_delays(base=0.001, cap=0.05)
         cur = n
         while True:
@@ -267,10 +285,10 @@ class TCPStore:
                 cur = int.from_bytes(buf.raw[:8], "little", signed=True)
                 if cur >= target:
                     return
-            if time.time() > deadline:
+            if dl.expired():
                 raise TimeoutError(f"barrier {name!r} timed out "
                                    f"({cur}/{target})")
-            time.sleep(next(delays))
+            dl.sleep(next(delays))
 
     def __del__(self):
         try:

@@ -501,7 +501,15 @@ class IntegrityCallback(TrainingCallback):
         have already published (non-blocking: a slow peer is compared
         on a later step, not waited on).  With ``peers`` set, only the
         dp replica group is consulted — everyone else's shard view
-        differs by construction."""
+        differs by construction.
+
+        The ``blocking=False`` below is load-bearing, not an
+        optimization: a blocking get here would make every fingerprint
+        interval a de-facto barrier — one dead rank stalls the whole
+        fleet's training loop.  The ``collective-discipline`` static
+        pass treats a blocking one-sided store wait as exactly that
+        hazard; this publish/compare exchange stays in its handshake
+        class only because nobody ever waits."""
         out = {}
         ranks = (self.peers if self.peers is not None
                  else range(self.world_size))

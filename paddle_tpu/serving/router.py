@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import threading
 import time
 from collections import deque
 
@@ -208,9 +209,15 @@ class FleetRouter:
             else:
                 self.replicas.append(Replica(rid, item, factory=None))
             self.metrics.breaker_open.labels(replica=str(rid)).set(0)
-        self._pending = deque()
+        # the telemetry server's scrape thread reads fleet_status()/
+        # fleet_health()/has_work() while the driving thread mutates
+        # routing state mid-step — serialize on one re-entrant lock
+        # (step() nests into helpers that retake it)
+        self._lock = threading.RLock()
+        self._pending = deque()     # guarded-by: self._lock
+        # guarded-by: self._lock
         self._assigned = {rep.replica_id: {} for rep in self.replicas}
-        self._next_id = 0
+        self._next_id = 0           # guarded-by: self._lock
         self._update_gauges()
 
     # ------------------------------------------------------------- lookup
@@ -227,20 +234,22 @@ class FleetRouter:
         next :meth:`step` (drain-based placement needs fresh health)."""
         sampling = sampling or SamplingParams()
         now = self._clock()
-        freq = FleetRequest(id=self._next_id, prompt=list(prompt),
-                            sampling=sampling, t_submit=now)
-        self._next_id += 1
-        if sampling.ttl_s is not None:
-            # the fleet-level deadline: survives failover (the remaining
-            # budget, not a fresh TTL, rides to the next replica)
-            freq.deadline = now + float(sampling.ttl_s)
-        freq._span = self.tracer.start_trace(
-            f"fleet#{freq.id}", start_s=now,
-            attributes={"request_id": freq.id,
-                        "prompt_len": len(freq.prompt),
-                        "max_new_tokens": sampling.max_new_tokens})
-        self._pending.append(freq)
-        self.metrics.pending_depth.set(len(self._pending))
+        with self._lock:
+            freq = FleetRequest(id=self._next_id, prompt=list(prompt),
+                                sampling=sampling, t_submit=now)
+            self._next_id += 1
+            if sampling.ttl_s is not None:
+                # the fleet-level deadline: survives failover (the
+                # remaining budget, not a fresh TTL, rides to the next
+                # replica)
+                freq.deadline = now + float(sampling.ttl_s)
+            freq._span = self.tracer.start_trace(
+                f"fleet#{freq.id}", start_s=now,
+                attributes={"request_id": freq.id,
+                            "prompt_len": len(freq.prompt),
+                            "max_new_tokens": sampling.max_new_tokens})
+            self._pending.append(freq)
+            self.metrics.pending_depth.set(len(self._pending))
         return freq
 
     # ----------------------------------------------------------- lifecycle
@@ -261,7 +270,11 @@ class FleetRouter:
         """Sync sampled tokens off ``rep`` after a successful step and
         retire requests the engine finished.  Harvested tokens are the
         failover ground truth — what the fleet has already emitted."""
-        table = self._assigned[rep.replica_id]
+        with self._lock:
+            table = self._assigned[rep.replica_id]
+            self._harvest_table(table, finished)
+
+    def _harvest_table(self, table, finished):
         for freq in list(table.values()):
             ereq = freq._engine_req
             out = ereq.output
@@ -291,24 +304,25 @@ class FleetRouter:
         harvested after a completed step ride along — the re-dispatch
         admission is ``prompt + tokens_out``, so the next replica
         rebuilds KV state from scratch and cannot double-emit."""
-        table = self._assigned[rep.replica_id]
-        moved = list(table.values())
-        table.clear()
-        try:
-            # frees the abandoned engine's pages (and closes request
-            # traces) when it is still reachable; a hard-dead engine
-            # has nothing left to salvage
-            rep.engine.evacuate()
-        except Exception:
-            pass    # silent-ok: a hard-dead engine has nothing to free
-        for freq in reversed(moved):
-            freq.state = FleetRequestState.PENDING
-            freq.replica_id = None
-            freq._engine_req = None
-            freq.redispatches += 1
-            self._pending.appendleft(freq)
-            self.metrics.redispatched.inc()
-        self.metrics.pending_depth.set(len(self._pending))
+        with self._lock:
+            table = self._assigned[rep.replica_id]
+            moved = list(table.values())
+            table.clear()
+            try:
+                # frees the abandoned engine's pages (and closes
+                # request traces) when it is still reachable; a
+                # hard-dead engine has nothing left to salvage
+                rep.engine.evacuate()
+            except Exception:
+                pass  # silent-ok: a hard-dead engine has nothing to free
+            for freq in reversed(moved):
+                freq.state = FleetRequestState.PENDING
+                freq.replica_id = None
+                freq._engine_req = None
+                freq.redispatches += 1
+                self._pending.appendleft(freq)
+                self.metrics.redispatched.inc()
+            self.metrics.pending_depth.set(len(self._pending))
         return moved
 
     def _on_replica_failure(self, rep, reason, exc=None):
@@ -356,10 +370,12 @@ class FleetRouter:
             replica=str(rep.replica_id)).inc()
         return delay
 
-    def _dispatch(self, freq, rep, now):
-        """Try the queue-head request on ``rep``.  Returns one of
-        "dispatched" / "backpressure" / "rejected" / "evicted" /
-        "failed" (replica, not request, at fault)."""
+    def _dispatch_locked(self, freq, rep, now):
+        """Try the queue-head request on ``rep`` (caller holds
+        ``self._lock`` — the ``_admit`` loop owns the queue while it
+        places work).  Returns one of "dispatched" / "backpressure" /
+        "rejected" / "evicted" / "failed" (replica, not request, at
+        fault)."""
         already = len(freq.tokens_out)
         kw = {"max_new_tokens": freq.sampling.max_new_tokens - already}
         if freq.deadline is not None:
@@ -414,28 +430,32 @@ class FleetRouter:
         a backpressuring or failing replica is skipped for the rest of
         this tick."""
         skip = set()
-        while self._pending:
-            cands = []
-            for rep in self.replicas:
-                if rep.replica_id in skip or not self._can_admit(rep, now):
-                    continue
-                try:
-                    h = rep.engine.health()
-                except OSError as e:
-                    self._on_replica_failure(rep, "probe", e)
-                    continue
-                cands.append((float(h.get("estimated_drain_s") or 0.0),
-                              (h.get("queue_depth") or 0)
-                              + (h.get("running") or 0),
-                              rep.replica_id, rep))
-            if not cands:
-                break
-            cands.sort(key=lambda c: c[:3])
-            rep = cands[0][3]
-            status = self._dispatch(self._pending[0], rep, now)
-            if status in ("backpressure", "failed"):
-                skip.add(rep.replica_id)
-        self.metrics.pending_depth.set(len(self._pending))
+        with self._lock:
+            while self._pending:
+                cands = []
+                for rep in self.replicas:
+                    if rep.replica_id in skip or \
+                            not self._can_admit(rep, now):
+                        continue
+                    try:
+                        h = rep.engine.health()
+                    except OSError as e:
+                        self._on_replica_failure(rep, "probe", e)
+                        continue
+                    cands.append(
+                        (float(h.get("estimated_drain_s") or 0.0),
+                         (h.get("queue_depth") or 0)
+                         + (h.get("running") or 0),
+                         rep.replica_id, rep))
+                if not cands:
+                    break
+                cands.sort(key=lambda c: c[:3])
+                rep = cands[0][3]
+                status = self._dispatch_locked(self._pending[0], rep,
+                                               now)
+                if status in ("backpressure", "failed"):
+                    skip.add(rep.replica_id)
+            self.metrics.pending_depth.set(len(self._pending))
 
     # --------------------------------------------------------------- drain
     def drain(self, replica_id, deadline_s=None, restart=True):
@@ -456,11 +476,13 @@ class FleetRouter:
             self.drain_deadline_s if deadline_s is None else
             float(deadline_s))
         rep.restart_after_drain = restart
+        with self._lock:
+            in_flight = len(self._assigned[replica_id])
         rep._drain_span = self.tracer.start_trace(
             "router::drain",
             attributes={"replica": replica_id,
                         "deadline_s": rep.drain_deadline,
-                        "in_flight": len(self._assigned[replica_id])})
+                        "in_flight": in_flight})
         self.metrics.drains.labels(replica=str(replica_id)).inc()
         self._update_gauges()
         return rep
@@ -576,8 +598,10 @@ class FleetRouter:
         return finished
 
     def has_work(self):
-        return bool(self._pending) or any(self._assigned[rep.replica_id]
-                                          for rep in self.replicas)
+        with self._lock:
+            return bool(self._pending) or \
+                any(self._assigned[rep.replica_id]
+                    for rep in self.replicas)
 
     def generate(self, prompts, sampling=None):
         """Batch convenience mirroring ``Engine.generate``: submit all,
@@ -605,45 +629,49 @@ class FleetRouter:
         replica can admit new work.  A single shedding replica is a
         soft signal (its own RETRY_AFTER says so) — only a fleet where
         every breaker is open or every replica is draining is down."""
-        per = {}
-        for rep in self.replicas:
-            per[str(rep.replica_id)] = {
-                "state": rep.state,
-                "breaker_open": rep.state == ReplicaState.DEAD,
-                "in_flight": len(self._assigned[rep.replica_id]),
-            }
-        admittable = sum(1 for rep in self.replicas
-                         if rep.state == ReplicaState.HEALTHY)
-        return {"healthy": admittable > 0,
-                "replicas_admittable": admittable,
-                "replicas_total": len(self.replicas),
-                "pending": len(self._pending),
-                "replicas": per}
+        with self._lock:
+            per = {}
+            for rep in self.replicas:
+                per[str(rep.replica_id)] = {
+                    "state": rep.state,
+                    "breaker_open": rep.state == ReplicaState.DEAD,
+                    "in_flight": len(self._assigned[rep.replica_id]),
+                }
+            admittable = sum(1 for rep in self.replicas
+                             if rep.state == ReplicaState.HEALTHY)
+            return {"healthy": admittable > 0,
+                    "replicas_admittable": admittable,
+                    "replicas_total": len(self.replicas),
+                    "pending": len(self._pending),
+                    "replicas": per}
 
     def fleet_status(self):
         """The ``/fleet`` endpoint payload: per-replica state + live
         engine health (guarded — a dead replica reports its error
         instead of wedging the scrape) and the router counters."""
         now = self._clock()
-        per = {}
-        for rep in self.replicas:
-            entry = {
-                "state": rep.state,
-                "breaker_open": rep.state == ReplicaState.DEAD,
-                "consecutive_failures": rep.consecutive_failures,
-                "probe_misses": rep.probe_misses,
-                "backpressure_for_s": max(0.0, rep.not_before - now),
-                "in_flight": len(self._assigned[rep.replica_id]),
-                "restartable": rep.factory is not None,
-            }
-            if rep.drain_deadline is not None:
-                entry["drain_deadline_in_s"] = rep.drain_deadline - now
-            try:
-                entry["engine"] = rep.engine.health()
-            except OSError as e:
-                entry["engine"] = {"error": repr(e)}
-            per[str(rep.replica_id)] = entry
-        out = self.fleet_health()
-        out["replicas"] = per
-        out["counters"] = self.metrics.snapshot()
-        return out
+        with self._lock:
+            per = {}
+            for rep in self.replicas:
+                entry = {
+                    "state": rep.state,
+                    "breaker_open": rep.state == ReplicaState.DEAD,
+                    "consecutive_failures": rep.consecutive_failures,
+                    "probe_misses": rep.probe_misses,
+                    "backpressure_for_s": max(0.0,
+                                              rep.not_before - now),
+                    "in_flight": len(self._assigned[rep.replica_id]),
+                    "restartable": rep.factory is not None,
+                }
+                if rep.drain_deadline is not None:
+                    entry["drain_deadline_in_s"] = \
+                        rep.drain_deadline - now
+                try:
+                    entry["engine"] = rep.engine.health()
+                except OSError as e:
+                    entry["engine"] = {"error": repr(e)}
+                per[str(rep.replica_id)] = entry
+            out = self.fleet_health()
+            out["replicas"] = per
+            out["counters"] = self.metrics.snapshot()
+            return out

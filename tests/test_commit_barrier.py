@@ -235,3 +235,74 @@ class TestBarrierIntrospection:
         assert st0["acked_ranks"] == {"step_0000000001": [0, 1]}
         assert results[1][1]["tokens"] == {
             "step_0000000001": "committed"}
+
+
+# ------------------------------------------------- bounded-wait fixes
+
+
+class TestBoundedWaits:
+    """Deadline regressions for the blocking waits the new
+    collective-discipline pass polices (ISSUE 13 satellite): each fix
+    is proven by a wall-clock bound, the lockedness-test analogue for
+    time — the probe fails on the pre-fix code."""
+
+    def test_store_wait_shares_one_deadline_across_keys(self,
+                                                        master_store):
+        """wait() on N missing keys used to cost N x timeout (each
+        get() got a fresh budget); now one Deadline spans them all."""
+        import time as _t
+
+        client = _client(master_store)
+        t0 = _t.monotonic()
+        with pytest.raises(TimeoutError):
+            client.wait(["never/a", "never/b", "never/c", "never/d"],
+                        timeout=0.4)
+        assert _t.monotonic() - t0 < 1.2    # one budget, not four
+
+    def test_store_get_zero_timeout_fails_fast(self, master_store):
+        """get(timeout=0) used to promote the falsy budget to the 30s
+        store default; an exhausted deadline must miss promptly."""
+        import time as _t
+
+        client = _client(master_store)
+        t0 = _t.monotonic()
+        with pytest.raises(TimeoutError):
+            client.get("never/zero", timeout=0)
+        assert _t.monotonic() - t0 < 1.0
+
+    def test_barrier_timeout_bounded(self, master_store):
+        """A counted barrier nobody else joins must miss within its
+        own budget (Deadline-bounded ack poll)."""
+        import time as _t
+
+        client = _client(master_store)
+        t0 = _t.monotonic()
+        with pytest.raises(TimeoutError):
+            client.barrier(name="lonely", timeout=0.4)
+        assert _t.monotonic() - t0 < 1.5
+
+    def test_begin_join_miss_is_protocol_error(self, master_store):
+        """A joiner whose rank 0 never opens a generation used to leak
+        a raw store TimeoutError; the miss is the barrier's own
+        failure type, within the barrier's budget."""
+        import time as _t
+
+        b = CommitBarrier(_client(master_store), 1, WORLD, timeout=0.4)
+        t0 = _t.monotonic()
+        with pytest.raises(CommitBarrierError):
+            b.begin("orphan_token")
+        assert _t.monotonic() - t0 < 2.0
+
+    def test_collect_acks_aborts_promptly_at_scale(self, master_store):
+        """Rank 0 committing a 16-rank world with zero acks: expiry
+        surfaces once — the old per-rank minimum wait overshot the
+        budget by O(world_size)."""
+        import time as _t
+
+        b = CommitBarrier(_client(master_store), 0, 16, timeout=0.4)
+        b.begin("tok_scale")
+        t0 = _t.monotonic()
+        with pytest.raises(CommitBarrierError):
+            b.commit("tok_scale", fn=lambda: None)
+        # pre-fix: 0.4 + 16*0.05 = 1.2s minimum; now ~0.4s
+        assert _t.monotonic() - t0 < 1.1

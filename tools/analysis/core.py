@@ -125,12 +125,20 @@ class Project:
     so self-tests can point a pass at a fixture tree."""
 
     def __init__(self, package_root=None, tests_root=None,
-                 repo_root=None):
+                 repo_root=None, scope=None):
         self.repo_root = os.path.abspath(repo_root or REPO_ROOT)
         self.package_root = os.path.abspath(
             package_root or os.path.join(self.repo_root, "paddle_tpu"))
         self.tests_root = os.path.abspath(
             tests_root or os.path.join(self.repo_root, "tests"))
+        #: repo-relative files to REPORT on (``--changed-only``).
+        #: None = everything.  Analysis stays whole-program — call
+        #: graphs, lock-order edges and axis universes are built from
+        #: every module regardless — only findings (and the per-module
+        #: loops of passes that opt in via :meth:`scoped_modules`) are
+        #: restricted, so a changed-only run can never report
+        #: differently from the full run on the files it covers.
+        self.scope = None if scope is None else set(scope)
         self._modules = None
         self._tests_blob = None
 
@@ -155,6 +163,19 @@ class Project:
             found.sort(key=lambda m: m.rel)
             self._modules = found
         return self._modules
+
+    def scoped_modules(self):
+        """The modules a per-module pass needs to analyze: everything
+        normally, only the changed set under ``--changed-only``.  Safe
+        ONLY for passes whose findings are a function of one module at
+        a time; cross-module passes keep iterating :meth:`modules` and
+        rely on the runner's finding-level scope filter."""
+        if self.scope is None:
+            return self.modules()
+        return [m for m in self.modules() if m.rel in self.scope]
+
+    def in_scope(self, rel):
+        return self.scope is None or rel in self.scope
 
     def module(self, rel_suffix):
         """The first module whose repo-relative path ends with
@@ -251,6 +272,8 @@ def run_pass(fn, project, baseline_dir=None):
     ``(new_findings, baselined_findings, elapsed_s)``."""
     t0 = time.perf_counter()
     raw = fn(project)
+    if project.scope is not None:
+        raw = [f for f in raw if project.in_scope(f.file)]
     kept = apply_suppressions(project, raw)
     base = load_baseline(fn.rule, baseline_dir)
     new = [f for f in kept if f.baseline_key not in base]
@@ -280,6 +303,30 @@ def run_all(project=None, rules=None, baseline_dir=None):
     return report
 
 
+def changed_files(repo_root=None):
+    """Repo-relative ``.py`` paths touched vs HEAD (staged, unstaged
+    and untracked).  Raises RuntimeError when git is unavailable —
+    ``--changed-only`` is a developer convenience, not a CI mode."""
+    import subprocess
+
+    root = os.path.abspath(repo_root or REPO_ROOT)
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(args, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"--changed-only needs git: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only: {' '.join(args)} failed: "
+                f"{proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    return out
+
+
 def main(argv=None):
     import argparse
 
@@ -301,6 +348,12 @@ def main(argv=None):
                     help="list registered passes and exit")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print grandfathered findings")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only in files changed vs "
+                         "HEAD (git diff + untracked); analysis stays "
+                         "whole-program, so results match the full "
+                         "run on the covered files.  Developer "
+                         "convenience — tier-1 runs full-repo.")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -308,7 +361,21 @@ def main(argv=None):
             print(f"{rule:26s} {REGISTRY[rule].doc}")
         return 0
 
-    project = Project(package_root=args.root)
+    scope = None
+    if args.changed_only:
+        try:
+            scope = changed_files()
+        except RuntimeError as e:
+            print(f"tools.analysis: {e}", file=sys.stderr)
+            return 2
+        if not scope:
+            print("tools.analysis: OK — --changed-only with no "
+                  "changed .py files, nothing to check")
+            return 0
+        print(f"tools.analysis: scoped to {len(scope)} changed "
+              f"file(s)")
+
+    project = Project(package_root=args.root, scope=scope)
 
     if args.write_baseline:
         for rule in args.write_baseline:

@@ -10,8 +10,11 @@ from tools.analysis.passes import (  # noqa: F401
     excepts,
     lock_discipline,
     trace_purity,
+    collective_discipline,
+    sharding_spec,
 )
 
 __all__ = ["atomic_writes", "metric_names", "fault_sites",
            "collective_instrumented", "bounded_retries", "excepts",
-           "lock_discipline", "trace_purity"]
+           "lock_discipline", "trace_purity", "collective_discipline",
+           "sharding_spec"]

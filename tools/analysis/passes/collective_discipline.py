@@ -1,0 +1,705 @@
+"""Rule ``collective-discipline``: SPMD collectives must be
+rank-uniform, order-stable, and deadline-bounded.
+
+The classic multi-host failure mode is a collective some ranks never
+reach: every participating rank blocks in ``all_reduce`` (or a counted
+store barrier) waiting for a peer that branched the other way on
+``rank == 0``.  PR 8's hang watchdog localizes that at *runtime* —
+after the fleet is already wedged; this pass is its static complement,
+the same way trace-purity is the static complement of the compile
+watchdog.  Three finding kinds, one rule id:
+
+- **rank-conditional hang** — a rank-uniform operation (a
+  ``distributed/collective.py`` op, a ``jax.lax`` collective, a store
+  ``barrier``, a ``CommitBarrier`` ``begin``/``ack``/``commit``)
+  reachable on only one side of a rank-conditional branch.  Guard
+  returns count: ``if rank != 0: return`` followed by ``barrier()``
+  means non-zero ranks never arrive.  A *blocking store wait* on one
+  side is also flagged — unless the other side *publishes* to the
+  store (``set``/``add``): a one-sided wait with a matching publish is
+  the sanctioned producer/consumer handshake (the begin/ack/commit
+  pairing — rank 0 publishes the generation, peers block on it; rank 0
+  blocks on acks that peers published), which is how
+  ``distributed/checkpoint.py`` passes clean on merit.
+- **order divergence** — both sides of a rank-conditional issue
+  rank-uniform collectives but in *different sequences*.  Every rank
+  reaches a collective, so nothing hangs immediately — ranks are
+  simply executing different programs, the cross-rank desync the
+  flight recorder can only name post-mortem (first divergent seq/op).
+- **unbounded blocking wait** — a blocking collective-plane wait
+  (store ``get``/``wait``/``barrier``) with no ``timeout=`` and no
+  :class:`~paddle_tpu.resilience.retry.Deadline` in scope, or a
+  ``timeout=`` that forwards an enclosing parameter whose default is
+  ``None``.  Extends the bounded-retries contract to the distributed
+  edge: one dead peer must cost a timeout, not a wedged fleet.
+
+Rank predicates are recognized intraprocedurally (``rank == 0``,
+``self.rank``, ``get_rank()``, ``jax.process_index()``,
+``is_first``/``is_master``-style names, and locals assigned from such
+expressions) plus ONE call level deep: ``if self._is_primary():``
+resolves through the local def / method / ``from``-import and inspects
+its returns.  Collective collection is also one call deep, so a
+rank-gated helper that wraps ``all_reduce`` still counts.
+
+Sanctioned asymmetric protocols are annotated in source with
+``# rank-ok: <reason>`` on the branch (or flagged) line — recorded and
+honored like ``lint-ok`` but self-documenting as a *protocol* sanction
+rather than a lint waiver; ``# lint-ok: collective-discipline
+<reason>`` also works.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Finding, register
+
+RULE = "collective-discipline"
+
+_RANK_OK = re.compile(r"#\s*rank-ok:\s*\S")
+
+#: terminal call names that are rank-uniform collectives wherever they
+#: appear (distinctive enough to match on any receiver)
+_COLLECTIVE_NAMES = {
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "ppermute", "psum", "psum_scatter", "pmax", "pmin", "pmean",
+}
+
+#: collective.py exports that are too generic to match by name alone —
+#: they count only when resolved through the collective module (a bare
+#: from-import or a ``collective.`` / ``dist.`` attribute)
+_GENERIC_COLLECTIVES = {"send", "recv", "scatter", "reduce", "split",
+                        "broadcast", "barrier"}
+
+#: CommitBarrier protocol methods (receiver must look barrier-like)
+_BARRIER_PROTO = {"begin", "ack", "commit"}
+
+#: blocking store waits / store publishes (receiver must look store-like)
+_STORE_WAITS = {"get", "wait"}
+_STORE_PUBLISHES = {"set", "add", "set_if_absent", "fadd", "mfadd",
+                    "msetnx", "delete_key", "publish"}
+
+#: rank-predicate identifiers: exact names and a containment pattern
+_RANK_NAMES = {"rank", "local_rank", "global_rank", "world_rank",
+               "node_rank", "process_index", "proc_index", "get_rank"}
+_RANK_PATTERN = re.compile(
+    r"(^|_)(rank|is_first|is_master|is_main|is_primary|is_last|"
+    r"is_leader|first_worker)($|_)")
+
+
+def _terminal(node):
+    """Last identifier of a Name/Attribute chain, or None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_rank_name(ident):
+    if ident is None:
+        return False
+    return ident in _RANK_NAMES or bool(_RANK_PATTERN.search(ident))
+
+
+# ------------------------------------------------------------ module index
+
+
+class _Index:
+    """Per-module: local defs/methods, from-imports, and which local
+    names denote the collective module (``import ... as dist``)."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        self.functions = {}      # name -> FunctionDef (module level)
+        self.methods = {}        # (class, name) -> FunctionDef
+        self.from_imports = {}   # local name -> (module, original)
+        self.collective_aliases = set()   # names denoting collective mod
+        tree = mod.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                src = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_imports[local] = (src, a.name)
+                    if a.name == "collective" or \
+                            src.endswith("collective"):
+                        if a.name == "collective":
+                            self.collective_aliases.add(local)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.endswith(".collective"):
+                        self.collective_aliases.add(
+                            a.asname or a.name.split(".")[0])
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                for node in cls.body:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.methods[(cls.name, node.name)] = node
+
+    def imports_collective_name(self, name):
+        """Is ``name`` a bare from-import out of the collective module?"""
+        src = self.from_imports.get(name)
+        return bool(src and (src[0].endswith("collective")
+                             or src[0].endswith("distributed")))
+
+
+class _Universe:
+    """Cross-module resolution: one level deep, by simple name."""
+
+    def __init__(self, project):
+        self.indexes = {}
+        for mod in project.modules():
+            if mod.tree is not None:
+                self.indexes[mod.rel] = _Index(mod)
+
+    def resolve_import(self, index, name):
+        """FunctionDef a from-import lands on in another module."""
+        src = index.from_imports.get(name)
+        if not src:
+            return None, None
+        module, orig = src
+        for rel, idx in self.indexes.items():
+            modname = rel[:-3].replace("/", ".")
+            if module and (modname == module
+                           or modname.endswith("." + module.lstrip("."))
+                           or modname.endswith(module.lstrip("."))):
+                fn = idx.functions.get(orig)
+                if fn is not None:
+                    return fn, idx
+        return None, None
+
+    def resolve_call(self, call, index, cls_name):
+        """(FunctionDef, owning _Index) for a call, one level deep."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            target = index.functions.get(fn.id)
+            if target is not None:
+                return target, index
+            return self.resolve_import(index, fn.id)
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                and cls_name is not None:
+            target = index.methods.get((cls_name, fn.attr))
+            if target is not None:
+                return target, index
+        return None, None
+
+
+# ------------------------------------------------------ rank predicates
+
+
+def _expr_mentions_rank(node, rank_locals):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id in rank_locals or _is_rank_name(sub.id):
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if _is_rank_name(sub.attr):
+                return True
+        elif isinstance(sub, ast.Call):
+            if _is_rank_name(_terminal(sub.func)):
+                return True
+    return False
+
+
+def _returns_rank_predicate(fn):
+    """One-call-deep predicate resolution: does ``fn``'s return
+    expression read a rank?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if _expr_mentions_rank(node.value, frozenset()):
+                return True
+    return False
+
+
+def _is_rank_conditional(test, rank_locals, universe, index, cls_name):
+    """Is this ``if`` test a rank predicate (direct, via a tainted
+    local, or through one resolvable call)?"""
+    if _expr_mentions_rank(test, rank_locals):
+        return True
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            target, _ = universe.resolve_call(sub, index, cls_name)
+            if target is not None and _returns_rank_predicate(target):
+                return True
+    return False
+
+
+def _rank_tainted_locals(fn, universe, index, cls_name):
+    """Locals assigned from rank expressions (``am_zero = rank == 0``,
+    ``primary = self._is_primary()``)."""
+    tainted = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            value = node.value
+            hit = _expr_mentions_rank(value, tainted)
+            if not hit and isinstance(value, ast.Call):
+                target, _ = universe.resolve_call(value, index, cls_name)
+                hit = target is not None and \
+                    _returns_rank_predicate(target)
+            if hit:
+                tainted.add(node.targets[0].id)
+    return tainted
+
+
+# ------------------------------------------------------- event collection
+
+
+class _Event:
+    """One collective-plane operation: kind is 'uniform', 'wait' or
+    'publish'; ``op`` names it for order comparison."""
+
+    __slots__ = ("kind", "op", "lineno")
+
+    def __init__(self, kind, op, lineno):
+        self.kind = kind
+        self.op = op
+        self.lineno = lineno
+
+
+def _local_aliases(fn):
+    """name -> unparsed source for simple local assignments; lets
+    ``b = self._barrier`` / ``s = self._stores[0]`` keep their flavor."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            try:
+                out[node.targets[0].id] = ast.unparse(node.value).lower()
+            except Exception:   # pragma: no cover - malformed nodes
+                pass
+    return out
+
+
+#: word/camel-hump-start match so 'restored'/'restore_fit_state' do
+#: not read as stores while 'self.store', '_store', 'TCPStore',
+#: 'stores[0]' all do
+_STOREISH = re.compile(r"(?<![a-z])[Ss]tore")
+_BARRIERISH = re.compile(r"(?<![a-z])[Bb]arrier")
+
+
+def _receiver_flavor(call, aliases, cls_name=None):
+    """'store' / 'barrier' / '' for an attribute call's receiver."""
+    if not isinstance(call.func, ast.Attribute):
+        return ""
+    src = _dotted(call.func.value) or ""
+    head = src.split(".")[0] if src else ""
+    if src == "self" and cls_name:
+        # a method on the store/barrier class itself: self IS one
+        src = cls_name
+    elif head in aliases:
+        src = src + " " + aliases[head]
+    if _STOREISH.search(src):
+        return "store"
+    if _BARRIERISH.search(src):
+        return "barrier"
+    return ""
+
+
+def _is_blocking_wait(call):
+    """A store get/wait is blocking unless ``blocking=False``."""
+    for kw in call.keywords:
+        if kw.arg == "blocking" and \
+                isinstance(kw.value, ast.Constant) and \
+                kw.value.value is False:
+            return False
+    return True
+
+
+def _classify_call(call, index, aliases, cls_name=None):
+    """The _Event a Call contributes, or None."""
+    name = _terminal(call.func)
+    if name is None:
+        return None
+    flavor = _receiver_flavor(call, aliases, cls_name)
+    if name in _COLLECTIVE_NAMES:
+        return _Event("uniform", name, call.lineno)
+    if name in _GENERIC_COLLECTIVES:
+        if isinstance(call.func, ast.Name):
+            if index.imports_collective_name(name):
+                return _Event("uniform", name, call.lineno)
+        elif isinstance(call.func, ast.Attribute):
+            head = _dotted(call.func.value) or ""
+            if head.split(".")[0] in index.collective_aliases or \
+                    head.endswith("collective"):
+                return _Event("uniform", name, call.lineno)
+            if name == "barrier" and flavor in ("store", "barrier"):
+                return _Event("uniform", "store.barrier", call.lineno)
+        return None
+    if name in _BARRIER_PROTO and flavor == "barrier":
+        return _Event("uniform", f"barrier.{name}", call.lineno)
+    if name in _STORE_WAITS and flavor == "store":
+        if _is_blocking_wait(call):
+            return _Event("wait", f"store.{name}", call.lineno)
+        return None
+    if name in _STORE_PUBLISHES and flavor in ("store", "barrier"):
+        return _Event("publish", f"store.{name}", call.lineno)
+    return None
+
+
+def _collect_events(stmts, index, aliases, universe, cls_name,
+                    depth=1, fn_seen=None):
+    """Ordered collective-plane events in a statement list, descending
+    into resolvable calls ``depth`` more levels."""
+    events = []
+    fn_seen = fn_seen or set()
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            ev = _classify_call(node, index, aliases, cls_name)
+            if ev is not None:
+                events.append(ev)
+                continue
+            if depth > 0:
+                target, tidx = universe.resolve_call(node, index,
+                                                     cls_name)
+                if target is not None and id(target) not in fn_seen:
+                    fn_seen = fn_seen | {id(target)}
+                    sub_aliases = _local_aliases(target)
+                    sub = _collect_events(
+                        target.body, tidx, sub_aliases, universe,
+                        cls_name, depth=depth - 1, fn_seen=fn_seen)
+                    for s in sub:
+                        events.append(_Event(s.kind, s.op, node.lineno))
+    return events
+
+
+def _terminates(stmts):
+    """Does this branch end control flow (return/raise/continue/break
+    as its final statement)?"""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    return isinstance(last, (ast.Return, ast.Raise, ast.Continue,
+                             ast.Break))
+
+
+# ------------------------------------------------------------- the walk
+
+
+def _rank_ok(mod, lineno):
+    """``# rank-ok: <reason>`` on the line or the comment block above."""
+    if _RANK_OK.search(mod.line_at(lineno)):
+        return True
+    ln = lineno - 1
+    while ln >= 1 and mod.line_at(ln).strip().startswith("#"):
+        if _RANK_OK.search(mod.line_at(ln)):
+            return True
+        ln -= 1
+    return False
+
+
+def _seq_str(events):
+    return " -> ".join(e.op for e in events) or "(none)"
+
+
+def _check_branches(mod, fn, if_node, body_ev, else_ev, else_label,
+                    findings):
+    """Compare the two sides of one rank-conditional."""
+    if _rank_ok(mod, if_node.lineno):
+        return
+    b_uniform = [e for e in body_ev if e.kind == "uniform"]
+    e_uniform = [e for e in else_ev if e.kind == "uniform"]
+    b_ops = [e.op for e in b_uniform]
+    e_ops = [e.op for e in e_uniform]
+    if b_ops != e_ops:
+        if b_ops and e_ops:
+            findings.append(Finding(
+                mod.rel, if_node.lineno, RULE,
+                f"order divergence in {fn.name}(): branches of a "
+                f"rank-conditional issue different collective "
+                f"sequences [{_seq_str(b_uniform)}] vs "
+                f"[{_seq_str(e_uniform)}] ({else_label}) — ranks will "
+                f"execute different programs; make the sequences "
+                f"identical or annotate the protocol with "
+                f"'# rank-ok: <reason>'"))
+        else:
+            one = b_uniform or e_uniform
+            where = "only one side" if if_node.orelse or not b_uniform \
+                else "only the rank-conditional branch"
+            findings.append(Finding(
+                mod.rel, one[0].lineno, RULE,
+                f"rank-conditional hang in {fn.name}(): collective "
+                f"'{one[0].op}' is reachable on {where} of a "
+                f"rank-conditional ({else_label}) — ranks on the "
+                f"other side never arrive and the fleet blocks; hoist "
+                f"the collective out of the branch or annotate the "
+                f"protocol with '# rank-ok: <reason>'"))
+        return
+    # uniform sequences agree; check one-sided blocking waits with no
+    # matching publish on the opposite side (the sanctioned handshake:
+    # one side waits on what the other side publishes)
+    b_wait = [e for e in body_ev if e.kind == "wait"]
+    e_wait = [e for e in else_ev if e.kind == "wait"]
+    b_pub = any(e.kind == "publish" for e in body_ev)
+    e_pub = any(e.kind == "publish" for e in else_ev)
+    for waits, other_pub in ((b_wait, e_pub), (e_wait, b_pub)):
+        if waits and not other_pub:
+            w = waits[0]
+            if _rank_ok(mod, w.lineno):
+                continue
+            findings.append(Finding(
+                mod.rel, w.lineno, RULE,
+                f"one-sided blocking wait in {fn.name}(): "
+                f"'{w.op}' blocks under a rank-conditional with no "
+                f"matching publish on the other side — if the "
+                f"producer rank took the other branch, nothing ever "
+                f"lands and this rank hangs until timeout; pair the "
+                f"wait with a publish or annotate with "
+                f"'# rank-ok: <reason>'"))
+
+
+class _FnWalker:
+    """Walk one function finding rank-conditionals and comparing the
+    collective-plane event sequences of their sides."""
+
+    def __init__(self, mod, fn, cls_name, universe, index):
+        self.mod = mod
+        self.fn = fn
+        self.cls_name = cls_name
+        self.universe = universe
+        self.index = index
+        self.aliases = _local_aliases(fn)
+        self.rank_locals = _rank_tainted_locals(fn, universe, index,
+                                                cls_name)
+        self.findings = []
+
+    def _events(self, stmts):
+        return _collect_events(stmts, self.index, self.aliases,
+                               self.universe, self.cls_name)
+
+    def run(self):
+        self._walk(self.fn.body)
+        return self.findings
+
+    def _walk(self, stmts):
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If) and _is_rank_conditional(
+                    stmt.test, self.rank_locals, self.universe,
+                    self.index, self.cls_name):
+                body_ev = self._events(stmt.body)
+                if stmt.orelse:
+                    else_ev = self._events(stmt.orelse)
+                    label = "if/else"
+                elif _terminates(stmt.body):
+                    # guard-return: the other side is the fallthrough
+                    else_ev = self._events(stmts[i + 1:])
+                    label = "guard return vs fallthrough"
+                else:
+                    # no else and no early exit: the other side is
+                    # empty — the branch body alone is the divergence
+                    else_ev = []
+                    label = "no else branch"
+                _check_branches(self.mod, self.fn, stmt, body_ev,
+                                else_ev, label, self.findings)
+                # still recurse for nested rank-conditionals
+                self._walk(stmt.body)
+                self._walk(stmt.orelse)
+                continue
+            for block in _sub_blocks(stmt):
+                self._walk(block)
+
+
+def _sub_blocks(stmt):
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if isinstance(block, list):
+            yield block
+    for handler in getattr(stmt, "handlers", ()) or ():
+        yield handler.body
+
+
+# --------------------------------------------------- unbounded-wait check
+
+
+def _param_defaults_none(fn):
+    """Parameter names whose default is literally None."""
+    args = fn.args
+    out = set()
+    pos = args.posonlyargs + args.args
+    for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if isinstance(d, ast.Constant) and d.value is None:
+            out.add(a.arg)
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant) and \
+                d.value is None:
+            out.add(a.arg)
+    return out
+
+
+def _mentions_deadline(fn):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                "deadline" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and (
+                "deadline" in node.attr.lower()
+                or node.attr in ("remaining", "expired")):
+            return True
+    return False
+
+
+def _check_unbounded_waits(mod, fn, index, universe, cls_name, findings):
+    aliases = _local_aliases(fn)
+    none_params = _param_defaults_none(fn)
+    has_deadline = _mentions_deadline(fn)
+    reassigned = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    reassigned.add(tgt.id)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal(node.func)
+        flavor = _receiver_flavor(node, aliases, cls_name)
+        is_wait = (name in _STORE_WAITS and flavor == "store"
+                   and _is_blocking_wait(node)) or \
+            (name == "barrier" and flavor in ("store", "barrier")
+             and isinstance(node.func, ast.Attribute))
+        if not is_wait:
+            continue
+        timeout_kw = next((kw for kw in node.keywords
+                           if kw.arg == "timeout"), None)
+        if timeout_kw is None:
+            if has_deadline:
+                continue
+            findings.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"unbounded blocking wait in {fn.name}(): "
+                f"'{name}(...)' has no timeout= and no Deadline in "
+                f"scope — a dead peer wedges this rank forever; pass "
+                f"timeout= or bound the enclosing loop with a "
+                f"Deadline"))
+            continue
+        v = timeout_kw.value
+        if isinstance(v, ast.Constant) and v.value is None:
+            if not has_deadline:
+                findings.append(Finding(
+                    mod.rel, node.lineno, RULE,
+                    f"unbounded blocking wait in {fn.name}(): "
+                    f"'{name}(timeout=None)' with no Deadline in "
+                    f"scope — pass a real bound"))
+            continue
+        if isinstance(v, ast.Name) and v.id in none_params and \
+                v.id not in reassigned and not has_deadline:
+            findings.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"unbounded blocking wait in {fn.name}(): "
+                f"'{name}(timeout={v.id})' forwards a parameter that "
+                f"defaults to None with no Deadline in scope — the "
+                f"default path has no total bound; derive the "
+                f"timeout from a Deadline or a non-None default"))
+
+
+# ---------------------------------------------------------------- driver
+
+
+def _functions_of(tree):
+    out = []
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, None)
+    return out
+
+
+#: identifiers whose presence in a function makes the wait check
+#: worth running at all
+_WAITISH = _STORE_WAITS | {"barrier"}
+
+#: any collective-plane identifier: a function containing one must run
+#: the branch walker even without a literal rank name in scope — the
+#: rank predicate may be a resolvable call ('if should_lead():')
+_OPISH = (_COLLECTIVE_NAMES | _GENERIC_COLLECTIVES | _BARRIER_PROTO
+          | _STORE_WAITS | _STORE_PUBLISHES)
+
+
+def _fn_idents(fn):
+    """Every Name/Attribute identifier in one function — the one-walk
+    gate that lets the expensive analyses skip the vast majority of
+    functions (no rank-y name => no rank conditional is expressible;
+    no get/wait/barrier => no blocking wait to bound)."""
+    idents = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            idents.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            idents.add(node.attr)
+    return idents
+
+
+@register(RULE, "collectives rank-uniform, order-stable, deadline-bounded")
+def find(project):
+    universe = _Universe(project)
+    findings = []
+    for mod in project.scoped_modules():
+        tree = mod.tree
+        if tree is None:
+            continue
+        index = universe.indexes.get(mod.rel)
+        if index is None:
+            continue
+        for cls_name, fn in _functions_of(tree):
+            idents = _fn_idents(fn)
+            has_rank = any(_is_rank_name(i) for i in idents)
+            if has_rank or idents & _OPISH:
+                walker = _FnWalker(mod, fn, cls_name, universe, index)
+                for f in walker.run():
+                    if not _rank_ok(mod, f.line):
+                        findings.append(f)
+            if idents & _WAITISH:
+                _check_unbounded_waits(mod, fn, index, universe,
+                                       cls_name, findings)
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings
+
+
+def collective_sites(project):
+    """Every recognized collective-plane call site
+    ``[(rel, lineno, kind, op)]`` — bench/tests introspect coverage."""
+    universe = _Universe(project)
+    out = []
+    for mod in project.modules():
+        tree = mod.tree
+        if tree is None:
+            continue
+        index = universe.indexes.get(mod.rel)
+        for cls_name, fn in _functions_of(tree):
+            aliases = _local_aliases(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    ev = _classify_call(node, index, aliases, cls_name)
+                    if ev is not None:
+                        out.append((mod.rel, ev.lineno, ev.kind, ev.op))
+    return sorted(set(out))

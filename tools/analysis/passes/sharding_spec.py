@@ -1,0 +1,514 @@
+"""Rule ``sharding-spec``: statically validate the mesh layer's
+PartitionSpec surface.
+
+``distributed/mesh.py`` made the PartitionSpec rule table and the
+named-axis mesh the single multi-chip contract — which means a typo'd
+axis name, a duplicated axis, a donate/sharding arity slip at a jit
+site, or a rule shadowed into deadness all compile fine and only
+surface as a wrong (or silently replicated) layout on real hardware.
+Four checks, one rule id:
+
+- **unknown axis** — every axis named in a ``P(...)`` /
+  ``PartitionSpec(...)`` literal must be declared by some mesh in the
+  package: ``mesh.AXIS_ORDER`` plus every literal axis tuple passed to
+  a ``Mesh(...)`` constructor (the hybrid engine's ``sep``/``ep``
+  axes live there).  ``resolve_spec`` prunes unknown axes to
+  *replication* at runtime — a typo doesn't error, it silently stops
+  sharding.
+- **duplicate axis** — one mesh axis may shard at most one dimension
+  of an array; ``P("mp", "mp")`` (including inside tuple entries) is
+  rejected by jax only at trace time, on hardware.
+- **donate/sharding arity** — a ``jax.jit`` call carrying both
+  ``in_shardings`` and ``donate_argnums`` (directly, via a kwargs
+  dict literal, or via ``d.update(...)`` / ``d["k"] = ...`` on one)
+  must keep every donated index inside the in_shardings tuple;
+  statically-resolvable mismatches are flagged (variables that can't
+  be resolved one assignment deep are skipped, not guessed).
+- **dead rule** — rule tables (module-level tuples of
+  ``(pattern, P(...))``) are matched first-match-wins; a rule whose
+  own sample matches are all captured by earlier rules can never fire.
+  Samples are generated from the pattern's parse tree (branches,
+  optional parts, char classes), so ``(^|[/_])wte$``-style patterns
+  are exercised, not string-hacked.  Unparseable patterns and rule
+  tables referenced nowhere else in the package are also flagged.
+
+Suppress a vetted site with ``# lint-ok: sharding-spec <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.core import Finding, register
+
+RULE = "sharding-spec"
+
+
+# ------------------------------------------------------- axis universe
+
+
+def _axis_order_of(mod):
+    """The AXIS_ORDER literal of one module, or None."""
+    tree = mod.tree
+    if tree is None:
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "AXIS_ORDER" \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    vals = [e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)]
+                    if vals:
+                        return tuple(vals)
+    return None
+
+
+def _declared_axes(project):
+    """Union of every axis a mesh in the package declares: AXIS_ORDER
+    plus literal axis tuples handed to ``Mesh(...)``.  Empty set means
+    the project declares no meshes — the axis check then stays silent
+    (nothing to validate against)."""
+    axes = set()
+    for mod in project.modules():
+        # cheap text gate: most modules declare no mesh at all
+        if "AXIS_ORDER" not in mod.text and "Mesh(" not in mod.text:
+            continue
+        order = _axis_order_of(mod)
+        if order:
+            axes.update(order)
+        tree = mod.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if name != "Mesh":
+                continue
+            for arg in list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg == "axis_names"]:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    axes.update(e.value for e in arg.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str))
+    return axes
+
+
+# ------------------------------------------------------ spec literals
+
+
+def _pspec_aliases(mod):
+    """Local names under which PartitionSpec is importable in ``mod``
+    (``P``, ``PartitionSpec``, custom aliases)."""
+    aliases = set()
+    tree = mod.tree
+    if tree is None:
+        return aliases
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _spec_axes(call):
+    """[(axis_name, lineno)] for every string axis in one P(...) call
+    (tuple entries flattened)."""
+    out = []
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, arg.lineno))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    out.append((e.value, e.lineno))
+    return out
+
+
+def _check_spec_literals(mod, axes, findings):
+    if "PartitionSpec" not in mod.text:
+        return
+    aliases = _pspec_aliases(mod)
+    if not aliases:
+        return
+    tree = mod.tree
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = node.func.id if isinstance(node.func, ast.Name) else \
+            node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        if name not in aliases:
+            continue
+        named = _spec_axes(node)
+        seen = {}
+        for ax, lineno in named:
+            if axes and ax not in axes:
+                findings.append(Finding(
+                    mod.rel, lineno, RULE,
+                    f"unknown mesh axis '{ax}' in PartitionSpec — no "
+                    f"mesh in the package declares it (known: "
+                    f"{sorted(axes)}); resolve_spec silently degrades "
+                    f"it to replication"))
+            if ax in seen:
+                findings.append(Finding(
+                    mod.rel, lineno, RULE,
+                    f"axis '{ax}' appears twice in one PartitionSpec "
+                    f"— a mesh axis may shard at most one dimension"))
+            seen[ax] = lineno
+
+
+# ------------------------------------------------- donate/sharding arity
+
+
+def _tuple_len(node):
+    """Static length of a tuple expression (literals, + concat,
+    * int), or None when unresolvable."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        if any(isinstance(e, ast.Starred) for e in node.elts):
+            return None
+        return len(node.elts)
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Add):
+            a, b = _tuple_len(node.left), _tuple_len(node.right)
+            return None if a is None or b is None else a + b
+        if isinstance(node.op, ast.Mult):
+            if isinstance(node.right, ast.Constant) and \
+                    isinstance(node.right.value, int):
+                a = _tuple_len(node.left)
+                return None if a is None else a * node.right.value
+            if isinstance(node.left, ast.Constant) and \
+                    isinstance(node.left.value, int):
+                b = _tuple_len(node.right)
+                return None if b is None else b * node.left.value
+    return None
+
+
+def _donate_indices(node):
+    """Static donated-argnum indices, or None when unresolvable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _is_jit_call(node):
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "jit"
+    if isinstance(fn, ast.Name):
+        return fn.id == "jit"
+    return False
+
+
+def _jit_kw_sources(fn):
+    """For each function: map kwargs-dict variable name ->
+    {key: value expr} accumulated from dict literals, ``dict(...)``
+    constructors, ``d["k"] = v`` and ``d.update(k=v)``."""
+    dicts = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name, value = node.targets[0].id, node.value
+            if isinstance(value, ast.Dict):
+                entry = dicts.setdefault(name, {})
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant):
+                        entry[k.value] = v
+            elif isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Name) and \
+                    value.func.id == "dict":
+                entry = dicts.setdefault(name, {})
+                for kw in value.keywords:
+                    if kw.arg:
+                        entry[kw.arg] = kw.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Subscript) and \
+                isinstance(node.targets[0].value, ast.Name):
+            sub = node.targets[0]
+            if isinstance(sub.slice, ast.Constant):
+                dicts.setdefault(sub.value.id, {})[
+                    sub.slice.value] = node.value
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "update" and \
+                isinstance(node.func.value, ast.Name):
+            entry = dicts.setdefault(node.func.value.id, {})
+            for kw in node.keywords:
+                if kw.arg:
+                    entry[kw.arg] = kw.value
+    return dicts
+
+
+def _locals_map(fn):
+    """Simple one-hop local assignments: name -> value expr."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _check_jit_sites(mod, findings):
+    # arity only matters where a jit call names shardings AND donates
+    if "in_shardings" not in mod.text or \
+            "donate_argnums" not in mod.text:
+        return
+    tree = mod.tree
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns + [tree]:
+        kw_dicts = _jit_kw_sources(fn) if fn is not tree else {}
+        local_vals = _locals_map(fn) if fn is not tree else {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or not _is_jit_call(node):
+                continue
+            shard_expr, donate_expr = None, None
+            for kw in node.keywords:
+                if kw.arg == "in_shardings":
+                    shard_expr = kw.value
+                elif kw.arg == "donate_argnums":
+                    donate_expr = kw.value
+                elif kw.arg is None and isinstance(kw.value, ast.Name):
+                    src = kw_dicts.get(kw.value.id)
+                    if src:
+                        shard_expr = shard_expr or \
+                            src.get("in_shardings")
+                        donate_expr = donate_expr or \
+                            src.get("donate_argnums")
+            if shard_expr is None or donate_expr is None:
+                continue
+            # resolve bare-name operands one assignment deep
+            if isinstance(shard_expr, ast.Name):
+                shard_expr = local_vals.get(shard_expr.id, shard_expr)
+            if isinstance(donate_expr, ast.Name):
+                donate_expr = local_vals.get(donate_expr.id,
+                                             donate_expr)
+            n_shard = _tuple_len(shard_expr)
+            donated = _donate_indices(donate_expr)
+            if n_shard is None or donated is None:
+                continue        # not statically resolvable: skip
+            bad = [d for d in donated if d >= n_shard]
+            if bad:
+                findings.append(Finding(
+                    mod.rel, node.lineno, RULE,
+                    f"donate/sharding arity mismatch at jax.jit site: "
+                    f"donate_argnums {sorted(donated)} donates "
+                    f"argument(s) {sorted(bad)} but in_shardings "
+                    f"covers only {n_shard} argument(s) — the donated "
+                    f"buffer has no declared layout"))
+
+
+# ---------------------------------------------------------- rule tables
+
+
+def _sample_strings(pattern, cap=16):
+    """Small set of strings matching ``pattern``, generated from its
+    parse tree.  Handles the constructs rule tables use: literals,
+    branches, optional subpatterns, char classes, anchors.  Returns []
+    when generation fails (pattern too rich — the check then skips)."""
+    try:
+        import re._parser as sre_parse      # py >= 3.11
+    except ImportError:                     # pragma: no cover
+        import sre_parse
+    try:
+        parsed = sre_parse.parse(pattern)
+    except re.error:
+        return []
+
+    def gen(tokens):
+        outs = [""]
+        for op, av in tokens:
+            op = str(op).lower().rsplit(".", 1)[-1]
+            if op == "literal":
+                outs = [o + chr(av) for o in outs]
+            elif op == "in":
+                ch = None
+                for iop, iav in av:
+                    iop = str(iop).lower().rsplit(".", 1)[-1]
+                    if iop == "literal":
+                        ch = chr(iav)
+                        break
+                    if iop == "range":
+                        ch = chr(iav[0])
+                        break
+                    if iop == "category":
+                        cat = str(iav).lower()
+                        ch = "0" if "digit" in cat else "a"
+                        break
+                if ch is None:
+                    return None
+                outs = [o + ch for o in outs]
+            elif op == "max_repeat" or op == "min_repeat":
+                lo, hi, sub = av
+                subs = gen(sub)
+                if subs is None:
+                    return None
+                variants = []
+                counts = {lo, min(hi, max(lo, 1))}
+                for n in sorted(counts):
+                    for s in subs:
+                        variants.append(s * n)
+                outs = [o + v for o in outs for v in variants][:cap]
+            elif op == "branch":
+                _, branches = av
+                variants = []
+                for b in branches:
+                    subs = gen(b)
+                    if subs is None:
+                        return None
+                    variants.extend(subs)
+                outs = [o + v for o in outs for v in variants][:cap]
+            elif op == "subpattern":
+                sub = av[-1]
+                subs = gen(sub)
+                if subs is None:
+                    return None
+                outs = [o + v for o in outs for v in subs][:cap]
+            elif op == "at":
+                continue                     # anchors add nothing
+            elif op == "any":
+                outs = [o + "x" for o in outs]
+            else:
+                return None
+        return outs[:cap]
+
+    out = gen(parsed)
+    if not out:
+        return []
+    # anchored '(^|[/_])' samples may start with '^' behavior — filter
+    # to strings the pattern actually matches
+    return [s for s in out if re.search(pattern, s)]
+
+
+def _rule_tables(mod):
+    """[(table_name, lineno, [(pattern, lineno)])] — module-level
+    tuples/lists of ``(str_const, Call)`` pairs."""
+    if "PartitionSpec" not in mod.text:
+        return []
+    tree = mod.tree
+    out = []
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)) or \
+                not value.elts:
+            continue
+        rules = []
+        for e in value.elts:
+            if isinstance(e, (ast.Tuple, ast.List)) and \
+                    len(e.elts) == 2 and \
+                    isinstance(e.elts[0], ast.Constant) and \
+                    isinstance(e.elts[0].value, str) and \
+                    isinstance(e.elts[1], ast.Call):
+                rules.append((e.elts[0].value, e.elts[0].lineno))
+            else:
+                rules = []
+                break
+        if rules:
+            out.append((node.targets[0].id, node.lineno, rules))
+    return out
+
+
+def _check_rule_tables(project, mod, findings):
+    tables = _rule_tables(mod)
+    if not tables:
+        return
+    for table_name, table_line, rules in tables:
+        # referenced anywhere else? (own declaration line excluded)
+        referenced = False
+        for other in project.modules():
+            text = other.text
+            if other is mod:
+                hits = [m for m in re.finditer(
+                    rf"\b{re.escape(table_name)}\b", text)]
+                own = len(mod.line_at(table_line))
+                referenced = any(
+                    text[:m.start()].count("\n") + 1 != table_line
+                    for m in hits)
+            elif re.search(rf"\b{re.escape(table_name)}\b", text):
+                referenced = True
+            if referenced:
+                break
+        if not referenced:
+            findings.append(Finding(
+                mod.rel, table_line, RULE,
+                f"rule table '{table_name}' is referenced nowhere — "
+                f"dead table; wire it into resolve_spec/param_specs "
+                f"or delete it"))
+        compiled = []
+        for pattern, lineno in rules:
+            try:
+                rx = re.compile(pattern)
+            except re.error as e:
+                findings.append(Finding(
+                    mod.rel, lineno, RULE,
+                    f"rule pattern {pattern!r} does not compile: {e}"))
+                compiled.append(None)
+                continue
+            compiled.append(rx)
+            samples = _sample_strings(pattern)
+            if not samples:
+                continue
+            shadowed_by = None
+            for j, earlier in enumerate(compiled[:-1]):
+                if earlier is None:
+                    continue
+                if all(earlier.search(s) for s in samples):
+                    shadowed_by = rules[j][0]
+                    break
+            if shadowed_by is not None:
+                findings.append(Finding(
+                    mod.rel, lineno, RULE,
+                    f"dead rule: pattern {pattern!r} can never win — "
+                    f"every match is captured first by earlier rule "
+                    f"{shadowed_by!r} (first match wins); reorder or "
+                    f"remove it"))
+
+
+# ---------------------------------------------------------------- driver
+
+
+@register(RULE, "PartitionSpecs use real axes; jit/rule tables coherent")
+def find(project):
+    axes = _declared_axes(project)
+    findings = []
+    for mod in project.scoped_modules():
+        if mod.tree is None:
+            continue
+        _check_spec_literals(mod, axes, findings)
+        _check_jit_sites(mod, findings)
+        _check_rule_tables(project, mod, findings)
+    # the jit walk visits module scope and each function scope; a call
+    # seen from both produces the identical finding twice — dedupe
+    seen, out = set(), []
+    for f in findings:
+        key = (f.file, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    out.sort(key=lambda f: (f.file, f.line))
+    return out
+
+
+def declared_axes(project):
+    """The axis universe the pass validates against — tests/bench
+    introspection."""
+    return sorted(_declared_axes(project))
