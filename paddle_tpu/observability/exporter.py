@@ -28,9 +28,11 @@ enforces that):
                 summary + newest records, in-flight collectives, and
                 the hang watchdog's last desync report / bundle paths
   ``/fleet``    the serving fleet router: per-replica state (breaker,
-                drain, backpressure window, live engine health) and
-                the ``router_*`` counters — 404 when no router is
-                attached
+                drain, backpressure window, live engine health, prefix-
+                cache state — hit/eviction counters, cached pages and
+                the gossiped radix-summary size steering cache-aware
+                dispatch) and the ``router_*`` counters — 404 when no
+                router is attached
   ``/integrity``  the silent-corruption sentinel: fingerprint/replay
                 check counts, last cross-rank-verified step, active
                 divergence state and recent events — 404 when no
@@ -335,7 +337,9 @@ class TelemetryServer(ThreadingHTTPServer):
                    "page_occupancy":
                        gauge_value("serving_page_occupancy"),
                    "estimated_drain_s":
-                       gauge_value("serving_estimated_drain_seconds")}
+                       gauge_value("serving_estimated_drain_seconds"),
+                   "prefix_cache_pages":
+                       gauge_value("serving_prefix_cache_pages")}
         training = gauge_value("training_healthy")
         training = bool(training) if training is not None else None
         if self.hang is not None:

@@ -59,13 +59,50 @@ Every request is additionally traced
 queued→chunk[i]→decode[i]→terminal through ``Engine.tracer``
 (chrome-trace / JSON exportable).
 
+Prefix-cache contract (:mod:`kv_cache` radix tree + refcounts — README
+"Serving fleet"): with ``Engine(prefix_cache=True)`` (the default),
+admission walks a radix tree keyed on page-aligned token-ID prefixes;
+the longest cached prefix is mapped into the new request's page table
+**read-only** (per-page refcount bump) and chunked prefill starts at
+the first uncached token — a fully-cached prompt copy-on-writes only
+its final page and re-runs exactly one token for logits.  A prompt's
+FULL pages enter the tree when its prefill completes.  Semantics the
+cache guarantees:
+
+- **token-identical** — cached K/V is a pure function of the token
+  prefix, so a cache-hit request's greedy output equals a cold prefill
+  of the same prompt (parity-tested, mid-chunk hits and failover
+  included).
+- **mid-decode pages are never shared** — only full *prompt* pages are
+  cached.  The partial final prompt page (and every decode page) keeps
+  receiving writes from its owning sequence, so it never enters the
+  tree; sharing it would let one request's decode corrupt another's
+  context.
+- **eviction vs shedding** — ``free()`` decrements, never force-frees:
+  a page returns to the pool only at refcount zero.  Cached pages no
+  sequence references are *evictable*: ``occupancy()`` counts them as
+  free and allocation LRU-evicts them on demand, so a warm cache never
+  trips the RETRY_AFTER watermarks — shedding fires on real memory
+  pressure only, and deadline eviction of a request mid-prefill
+  decrements its shared pages rather than corrupting its siblings.
+- ``defrag()`` relocates a shared page once and rewrites every
+  referencing page table plus its radix node.
+
 Fleet-router contract (:mod:`router` — README "Serving fleet"): a
 :class:`FleetRouter` over N replica engines is the fleet-level
 robustness unit.  Semantics it guarantees:
 
-- **drain-based balancing** — each admission goes to the admittable
-  replica with the lowest ``estimated_drain_s`` (queue depth + running
-  count break ties), so backlog self-levels across the fleet.
+- **drain-based, cache-aware balancing** — each admission goes to the
+  admittable replica with the best ``estimated_drain_s −
+  expected_prefix_hit_tokens × cache_hit_token_s`` score (queue depth
+  + running count break ties): backlog self-levels across the fleet,
+  and a request whose system prompt is already warm somewhere routes
+  there unless that replica's backlog outweighs the prefill saved.
+  Expected hits come from bounded radix summaries (hash-only, no token
+  ids) each replica publishes — in-process pulls by default,
+  :mod:`prefix_gossip` over TCPStore for cross-host fleets.  Gossip is
+  advisory: the target re-walks its own tree at admission, so stale
+  summaries cost FLOPs, never correctness.
 - **bounded backpressure** — a replica's RETRY_AFTER closes its
   admission window for ``max(retry_after_s, jittered exponential
   delay)`` capped at ``backoff_cap_s`` (``resilience.retry``'s
@@ -92,7 +129,11 @@ robustness unit.  Semantics it guarantees:
   backpressure, not an outage.
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
-from .kv_cache import PagedKVCache  # noqa: F401
+from .kv_cache import PagedKVCache, prefix_hashes  # noqa: F401
+from .prefix_gossip import (  # noqa: F401
+    PrefixSummaryPublisher,
+    collect_prefix_summaries,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,

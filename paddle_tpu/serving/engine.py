@@ -65,6 +65,18 @@ engine shares the process-wide tracer by default; with an injected
 ``clock`` it gets a private Tracer on that clock so tests drive span
 timestamps deterministically.
 
+Prefix reuse (``prefix_cache=True``, the default): admission walks the
+page pool's radix tree for the longest cached page-aligned prefix of
+the prompt, maps those pages in read-only (a refcount bump instead of
+prefill FLOPs) and starts chunked prefill at the first uncached token —
+mid-chunk starts are fine, the planner just sees a shorter remaining
+prompt.  A prompt whose prefill completes inserts its full pages back
+into the tree.  K/V is a pure function of the token prefix, so a
+cache-hit request's greedy output is token-identical to a cold prefill
+of the same prompt (parity-tested).  Zero-ref cached pages are counted
+as free for watermark/occupancy purposes and LRU-evicted on demand, so
+a warm cache never sheds traffic it could serve.
+
 Sampling is host-side (greedy / temperature / top-k / top-p) with a
 per-request numpy Generator seeded at submit, so outputs are
 deterministic for a fixed seed regardless of batch composition.
@@ -204,7 +216,7 @@ class Engine:
                  default_ttl_s=None, shed_occupancy_high=None,
                  shed_occupancy_low=None, shed_queue_high=None,
                  shed_queue_low=None, drain_floor_s=None,
-                 clock=None, tracer=None, mesh=None):
+                 prefix_cache=True, clock=None, tracer=None, mesh=None):
         self.cfg = cfg
         self._clock = clock or time.perf_counter
         if tracer is None:
@@ -241,6 +253,12 @@ class Engine:
             num_layers=cfg.num_layers, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim, num_pages=num_pages, page_size=page_size,
             max_seq_len=cfg.max_seq_len, dtype=cfg.jdtype())
+        # prefix/radix reuse: admission walks the radix tree so a shared
+        # system prompt is a refcount bump instead of prefill FLOPs;
+        # completed prompts are inserted back.  Off = always-cold
+        # admission (the bench's cold-fleet baseline).
+        self.prefix_cache = bool(prefix_cache)
+        self._prefix_seen = {"hits": 0, "hit_tokens": 0, "evictions": 0}
         self.metrics = ServingMetrics()
         self._queue = deque()
         self._slots = [None] * max_batch_size
@@ -483,10 +501,22 @@ class Engine:
                 return
             req = self._queue[0]
             # chunk-granularity admission: pages for the FIRST chunk
-            # only — later chunks extend the table step by step
-            first = min(self.chunk_len, len(req.prompt))
-            if not self.cache.allocate(req.id, first):
-                return                       # FIFO: no queue-jumping
+            # only — later chunks extend the table step by step.  With
+            # the prefix cache on, the radix walk happens here: the
+            # longest cached prefix of the prompt is mapped in
+            # read-only (refcount bump) and chunked prefill starts at
+            # the first uncached token
+            if self.prefix_cache:
+                matched = self.cache.allocate_prefixed(
+                    req.id, req.prompt, self.chunk_len)
+                if matched is None:
+                    return                   # FIFO: no queue-jumping
+            else:
+                matched = 0
+                first = min(self.chunk_len, len(req.prompt))
+                if not self.cache.allocate(req.id, first):
+                    return                   # FIFO: no queue-jumping
+            req.prompt_pos = matched
             self._queue.popleft()
             now = self._clock()
             req.state = RequestState.RUNNING
@@ -500,6 +530,7 @@ class Engine:
             if req._span is not None:
                 req._span.set_attributes({
                     "batch_slot": slot,
+                    "prefix_hit_tokens": matched,
                     "occupancy_at_admit":
                         round(self.cache.occupancy(), 4)})
 
@@ -644,6 +675,13 @@ class Engine:
                 req._chunks_done += 1
                 if ctx < len(req.prompt):
                     continue                 # more chunks to go
+                # prompt complete: its FULL pages are now reusable K/V —
+                # register them in the radix tree so the next request
+                # sharing this prefix skips the prefill FLOPs (the
+                # partial final page keeps taking decode writes and is
+                # never shared)
+                if self.prefix_cache:
+                    self.cache.insert_prefix(req.id, req.prompt)
                 # the chunk that completed the prompt falls through and
                 # samples the request's first token — TTFT
             tok = self._sample_token(logits[i], req)
@@ -740,8 +778,32 @@ class Engine:
         self.metrics.page_occupancy.set(self.cache.occupancy())
         self.metrics.queue_depth.set(len(self._queue))
         self.metrics.estimated_drain_s.set(self.estimated_drain_s())
+        self._sync_prefix_metrics()
         done, self._just_finished = self._just_finished, []
         return done
+
+    def _sync_prefix_metrics(self):
+        """Fold the cache's monotonic prefix counters into the
+        serving_prefix_* registry series (delta sync: the cache doesn't
+        know about metrics, the registry wants monotonic counters)."""
+        stats = self.cache.prefix_stats()
+        m = self.metrics
+        for key, counter in (("hits", m.prefix_cache_hits),
+                             ("hit_tokens", m.prefix_hit_tokens),
+                             ("evictions", m.prefix_cache_evictions)):
+            delta = stats[key] - self._prefix_seen[key]
+            if delta:
+                counter.inc(delta)
+                self._prefix_seen[key] = stats[key]
+        m.prefix_cache_pages.set(stats["cached_pages"])
+
+    def prefix_summary(self, max_entries=32):
+        """Bounded radix-tree summary for cache-aware routing — the
+        per-replica payload the fleet gossips (root hashes + hit
+        stats).  See ``PagedKVCache.prefix_summary``."""
+        out = self.cache.prefix_summary(max_entries=max_entries)
+        out["enabled"] = self.prefix_cache
+        return out
 
     def evacuate(self):
         """Pull EVERY in-flight request off this engine — running
@@ -780,7 +842,9 @@ class Engine:
                 "running": len(self._running()),
                 "page_occupancy": self.cache.occupancy(),
                 "estimated_drain_s": self.estimated_drain_s(),
-                "decode_rate_tok_s": self._decode_rate_ewma}
+                "decode_rate_tok_s": self._decode_rate_ewma,
+                "prefix_cache": {"enabled": self.prefix_cache,
+                                 **self.cache.prefix_stats()}}
 
     def generate(self, prompts, sampling=None):
         """Batch convenience: submit all prompts, drive the scheduler to

@@ -1,5 +1,5 @@
 """Block-paged KV cache — the physical memory manager behind the serving
-engine.
+engine, with refcounted pages and a radix prefix cache.
 
 vLLM's PagedAttention memory model on TPU (arXiv:2604.15464): K/V live in
 fixed-size pages drawn from one shared pool, a per-sequence page table
@@ -15,26 +15,112 @@ the table as later chunks (and decode tokens) land, so a long prompt
 holds exactly the pages its written tokens need — never a whole-prompt
 reservation sitting idle while other requests starve.
 
-Host-side bookkeeping (free list, page tables) is plain Python — it sits
-on the scheduler path, not the device path; the device only ever sees the
-dense page arrays plus int32 tables the engine builds per step.
+Prefix reuse (the millions-of-users economics): chat traffic shares a
+system prompt, and re-prefilling it per request burns FLOPs on K/V the
+pool already holds.  Every page therefore carries a **refcount**, and a
+**radix tree keyed on page-aligned token-ID prefixes** (one edge = one
+FULL page of prompt tokens) indexes pages whose contents are a pure
+function of their token prefix.  ``allocate_prefixed`` walks the tree
+for the longest cached prefix of a new prompt, maps those pages into
+the new sequence's table read-only (a refcount bump instead of prefill
+FLOPs), and allocates fresh pages only from the first uncached token.
+When the *whole* prompt is cached the final page is **copied on write**
+(the one page the new sequence must write its last prompt token into)
+so shared pages are never mutated.  Only full prompt pages ever enter
+the tree: a partial final page keeps receiving decode writes and
+mid-decode pages are owned by exactly one sequence, never shared.
+
+Freeing decrements; a page returns to the free list only at refcount
+zero.  Cached pages nobody references (tree-only, refcount 1) are
+*evictable*: ``num_free_pages``/``occupancy()`` count them as free, so
+a warm cache never trips the engine's occupancy watermark (no
+RETRY_AFTER storm from cache residue), and allocation under pressure
+transparently evicts least-recently-used zero-ref leaves before
+failing.
+
+Host-side bookkeeping (free list, page tables, radix tree) is plain
+Python — it sits on the scheduler path, not the device path; the device
+only ever sees the dense page arrays plus int32 tables the engine
+builds per step.  Shared pages are read through the existing page-table
+indirection — the ragged kernel needs no change.  The tree, refcount
+map and prefix stats are read by telemetry scrape threads while the
+scheduler mutates them, so they are lock-guarded (and annotated for the
+lock-discipline pass).
 """
 from __future__ import annotations
 
+import hashlib
 import math
+import threading
 
 import jax.numpy as jnp
 
-__all__ = ["PagedKVCache"]
+__all__ = ["PagedKVCache", "prefix_hashes"]
+
+#: chain hash of the empty prefix (the radix root)
+_ROOT_HASH = "radix-root"
+
+
+def _chunk_hash(parent_hash, key):
+    """Chain hash of one page-aligned token chunk appended to a prefix.
+
+    Stable across processes (hashlib, not ``hash()``) — it is the wire
+    identity of a cached prefix in the fleet gossip protocol: a router
+    hashing a prompt's page chunks client-side can test membership
+    against a replica's published radix summary without shipping token
+    ids."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_hash.encode("ascii"))
+    h.update(",".join(str(int(t)) for t in key).encode("ascii"))
+    return h.hexdigest()
+
+
+def prefix_hashes(token_ids, page_size, max_pages=64):
+    """Chain hashes of the page-aligned prefixes of ``token_ids``.
+
+    ``prefix_hashes(t, ps)[i]`` identifies the prefix ``t[:(i+1)*ps]``
+    and equals the ``chain_hash`` of the radix node any
+    :class:`PagedKVCache` holds for that exact prefix — the client side
+    of cache-aware routing: the deepest hash present in a replica's
+    prefix summary is that replica's expected hit length."""
+    out, h = [], _ROOT_HASH
+    for i in range(min(len(token_ids) // page_size, max_pages)):
+        key = token_ids[i * page_size:(i + 1) * page_size]
+        h = _chunk_hash(h, key)
+        out.append(h)
+    return out
+
+
+class _PrefixNode:
+    """One radix-tree edge: one FULL page of prompt tokens.
+
+    ``key`` is the page's token tuple, ``page`` the physical page id
+    whose K/V encodes exactly the root→here token prefix,
+    ``chain_hash`` the gossip identity of that prefix, ``last_used`` a
+    logical LRU tick (clock-free: deterministic under injected engine
+    clocks)."""
+
+    __slots__ = ("key", "page", "parent", "children", "chain_hash",
+                 "last_used")
+
+    def __init__(self, key, page, parent, chain_hash, last_used):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children = {}
+        self.chain_hash = chain_hash
+        self.last_used = last_used
 
 
 class PagedKVCache:
-    """Page pool + per-sequence page tables with alloc/free/defrag.
+    """Page pool + per-sequence page tables with alloc/free/defrag,
+    per-page refcounts and a radix prefix cache.
 
     The arrays (`k_pages`/`v_pages`) are functional: jitted model steps
     take them as inputs and return updated copies; the engine assigns the
     results back.  Bookkeeping methods never touch the arrays except
-    ``defrag`` (a gather) and ``reset`` (a fill).
+    ``defrag`` (a gather), the copy-on-write path of
+    ``allocate_prefixed`` (one page copy) and ``reset`` (a fill).
     """
 
     def __init__(self, *, num_layers, num_heads, head_dim, num_pages,
@@ -50,25 +136,46 @@ class PagedKVCache:
         # LIFO free list: recently-freed (still-warm) pages are reused first
         self._free = list(range(num_pages - 1, -1, -1))
         self._tables = {}          # seq_id -> [physical page ids]
+        # scheduler thread vs telemetry scrapes (prefix_summary via
+        # /fleet) race on the shared prefix structures — one re-entrant
+        # lock serializes them (public methods lock, _locked helpers
+        # assert the caller holds it)
+        self._lock = threading.RLock()
+        self._ref = {}             # page -> refcount  # guarded-by: self._lock
+        self._radix = _PrefixNode((), None, None, _ROOT_HASH, 0)  # guarded-by: self._lock
+        self._tree_pages = set()   # pages held by radix nodes  # guarded-by: self._lock
+        # monotonic counters for the serving_prefix_* metrics (the
+        # engine syncs deltas each step)
+        self._prefix_stats = {"hits": 0, "hit_tokens": 0,
+                              "evictions": 0,
+                              "inserted_pages": 0}  # guarded-by: self._lock
+        self._tick = 0             # logical LRU clock
 
     # ------------------------------------------------------------ queries
     @property
     def num_free_pages(self):
-        return len(self._free)
+        """Allocatable pages: the free list PLUS cached prefix pages no
+        sequence references (refcount 1, tree-only) — those are evicted
+        on demand, so a warm cache never looks like memory pressure."""
+        with self._lock:
+            return len(self._free) + self._evictable_locked()
 
     @property
     def num_used_pages(self):
-        return self.num_pages - len(self._free)
+        return self.num_pages - self.num_free_pages
 
     def occupancy(self):
-        """Fraction of the pool in use, 0..1."""
+        """Fraction of the pool in *hard* use (pages some sequence
+        references), 0..1.  Evictable cached pages do not count — the
+        watermark shedding reading this must not RETRY_AFTER traffic a
+        one-page eviction would admit."""
         return self.num_used_pages / self.num_pages
 
     def pages_for(self, num_tokens):
         return math.ceil(num_tokens / self.page_size)
 
     def can_allocate(self, num_tokens):
-        return self.pages_for(num_tokens) <= len(self._free)
+        return self.pages_for(num_tokens) <= self.num_free_pages
 
     def seq_ids(self):
         return list(self._tables)
@@ -85,15 +192,18 @@ class PagedKVCache:
             raise ValueError(
                 f"seq {seq_id!r}: {num_tokens} tokens need {need} pages > "
                 f"max_pages_per_seq {self.max_pages_per_seq}")
-        if need > len(self._free):
-            return False
-        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        with self._lock:
+            pages = self._take_pages_locked(need)
+            if pages is None:
+                return False
+            self._tables[seq_id] = pages
         return True
 
     def extend(self, seq_id, num_tokens):
         """Grow seq_id's table to cover num_tokens total.  True on
         success; False (table unchanged) when the pool is exhausted —
-        the engine then preempts."""
+        the engine then preempts.  Under pressure, zero-ref cached
+        prefix pages are LRU-evicted before giving up."""
         table = self._tables[seq_id]
         need = self.pages_for(num_tokens) - len(table)
         if need <= 0:
@@ -102,23 +212,246 @@ class PagedKVCache:
             raise ValueError(
                 f"seq {seq_id!r}: extend to {num_tokens} tokens exceeds "
                 f"max_pages_per_seq {self.max_pages_per_seq}")
-        if need > len(self._free):
-            return False
-        table.extend(self._free.pop() for _ in range(need))
+        with self._lock:
+            pages = self._take_pages_locked(need)
+            if pages is None:
+                return False
+            table.extend(pages)
         return True
 
     def free(self, seq_id):
-        """Return seq_id's pages to the pool (stale contents are fine:
-        pages are fully overwritten before they are ever read again)."""
-        for p in self._tables.pop(seq_id):
-            self._free.append(p)
+        """Drop seq_id's references: each page's refcount is
+        DECREMENTED, and only pages nobody else holds (no other table,
+        no radix node) return to the pool.  Stale contents of truly
+        freed pages are fine: pages are fully overwritten before they
+        are ever read again."""
+        with self._lock:
+            for p in self._tables.pop(seq_id):
+                self._release_page_locked(p)
 
     def reset(self):
-        """Free everything and zero the pool."""
-        self._tables.clear()
-        self._free = list(range(self.num_pages - 1, -1, -1))
-        self.k_pages = jnp.zeros_like(self.k_pages)
-        self.v_pages = jnp.zeros_like(self.v_pages)
+        """Free everything — tables, prefix cache, refcounts — and zero
+        the pool.  Prefix hit/eviction counters stay monotonic (they
+        feed Prometheus counters)."""
+        with self._lock:
+            self._tables.clear()
+            self._free = list(range(self.num_pages - 1, -1, -1))
+            self._ref = {}
+            self._radix = _PrefixNode((), None, None, _ROOT_HASH, 0)
+            self._tree_pages = set()
+            self.k_pages = jnp.zeros_like(self.k_pages)
+            self.v_pages = jnp.zeros_like(self.v_pages)
+
+    # --------------------------------------------------- locked internals
+    def _release_page_locked(self, page):
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            del self._ref[page]
+            self._free.append(page)
+
+    def _take_pages_locked(self, need):
+        """Pop ``need`` pages (refcount 1 each), LRU-evicting zero-ref
+        cached prefixes as required.  None (nothing taken) when the
+        pool genuinely can't cover it."""
+        while len(self._free) < need:
+            if not self._evict_one_locked():
+                return None
+        pages = [self._free.pop() for _ in range(need)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def _evictable_locked(self):
+        """Cached pages reclaimable by eviction: tree-held with no
+        sequence reference.  A sequence referencing a node references
+        every ancestor too, so refcount-1 tree pages always form
+        evictable (leaf-first) subtrees."""
+        return sum(1 for p in self._tree_pages if self._ref.get(p) == 1)
+
+    def _iter_nodes_locked(self):
+        stack = list(self._radix.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
+
+    def _evict_one_locked(self):
+        """Evict the least-recently-used zero-ref LEAF node (leaf-only:
+        an inner node's page is the prefix its cached descendants
+        attend through).  Returns True when a page was reclaimed."""
+        victim = None
+        for node in self._iter_nodes_locked():
+            if node.children or self._ref.get(node.page) != 1:
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return False
+        victim.parent.children.pop(victim.key)
+        self._tree_pages.discard(victim.page)
+        self._release_page_locked(victim.page)
+        self._prefix_stats["evictions"] += 1
+        return True
+
+    def _match_locked(self, token_ids):
+        """Longest cached page-aligned prefix of token_ids: the radix
+        walk.  Returns the node-chain pages (LRU-touched)."""
+        self._tick += 1
+        node, pages = self._radix, []
+        for i in range(len(token_ids) // self.page_size):
+            key = tuple(int(t) for t in
+                        token_ids[i * self.page_size:
+                                  (i + 1) * self.page_size])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = self._tick
+            pages.append(child.page)
+            node = child
+        return pages
+
+    # ------------------------------------------------------- prefix reuse
+    def allocate_prefixed(self, seq_id, token_ids, chunk_tokens):
+        """Admission with prefix reuse.
+
+        Walks the radix tree for the longest cached page-aligned prefix
+        of ``token_ids``, maps those pages into ``seq_id``'s new table
+        read-only (refcount bump), and allocates fresh pages covering
+        the first ``chunk_tokens`` uncached tokens — prefill starts at
+        the first uncached token.  When the whole prompt is cached the
+        match is capped at ``len(token_ids) - 1`` (the model must still
+        run ≥1 token for logits) and the final page is **copied on
+        write**: the copy receives the last prompt token's K/V, the
+        shared original is never written.
+
+        Returns the number of prompt tokens served from cache (0 = cold
+        admission), or None — nothing allocated, no refcount moved —
+        when the pool can't cover the request even after evicting every
+        zero-ref cached page."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id!r} already allocated")
+        n = len(token_ids)
+        with self._lock:
+            shared = self._match_locked(token_ids)
+            cow_src = None
+            if shared and len(shared) * self.page_size >= n:
+                # fully cached: COW the final page, re-run its last token
+                cow_src = shared[-1]
+                shared = shared[:-1]
+                matched = n - 1
+            else:
+                matched = len(shared) * self.page_size
+            cover = min(matched + max(1, int(chunk_tokens)), n)
+            need = self.pages_for(cover)
+            if need > self.max_pages_per_seq:
+                raise ValueError(
+                    f"seq {seq_id!r}: {cover} tokens need {need} pages > "
+                    f"max_pages_per_seq {self.max_pages_per_seq}")
+            fresh = self._take_pages_locked(need - len(shared))
+            if fresh is None:
+                return None
+            for p in shared:
+                self._ref[p] += 1
+            if cow_src is not None:
+                # one-page copy-on-write; cow page is fresh[0] (owned)
+                dst = fresh[0]
+                self.k_pages = self.k_pages.at[:, dst].set(
+                    self.k_pages[:, cow_src])
+                self.v_pages = self.v_pages.at[:, dst].set(
+                    self.v_pages[:, cow_src])
+            self._tables[seq_id] = shared + fresh
+            if matched:
+                self._prefix_stats["hits"] += 1
+                self._prefix_stats["hit_tokens"] += matched
+            return matched
+
+    def insert_prefix(self, seq_id, token_ids):
+        """Register ``seq_id``'s FULL prompt pages in the radix tree
+        (each newly cached page gets a tree refcount).  Called by the
+        engine when a prompt's prefill completes — from then on an
+        identical prefix is a refcount bump instead of prefill FLOPs.
+        The partial final page (if any) never enters the tree: decode
+        keeps writing into it, and mid-decode pages are never shared.
+        Returns the number of pages newly inserted."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            return 0
+        added = 0
+        with self._lock:
+            self._tick += 1
+            node = self._radix
+            for i in range(len(token_ids) // self.page_size):
+                key = tuple(int(t) for t in
+                            token_ids[i * self.page_size:
+                                      (i + 1) * self.page_size])
+                child = node.children.get(key)
+                if child is None:
+                    page = table[i]
+                    child = _PrefixNode(
+                        key, page, node,
+                        _chunk_hash(node.chain_hash, key), self._tick)
+                    node.children[key] = child
+                    self._ref[page] = self._ref.get(page, 0) + 1
+                    self._tree_pages.add(page)
+                    self._prefix_stats["inserted_pages"] += 1
+                    added += 1
+                else:
+                    child.last_used = self._tick
+                node = child
+        return added
+
+    def prefix_stats(self):
+        """Monotonic prefix-cache counters plus the live cached-page
+        gauge — the engine's serving_prefix_* metrics source."""
+        with self._lock:
+            out = dict(self._prefix_stats)
+            out["cached_pages"] = len(self._tree_pages)
+        return out
+
+    def prefix_summary(self, max_entries=32):
+        """Bounded radix summary for fleet gossip: the ``chain_hash`` →
+        cached-prefix-token-depth map of the ``max_entries`` most
+        recently used nodes, plus the stats counters.  A router hashes
+        an incoming prompt with :func:`prefix_hashes` and the deepest
+        hash present here is this pool's expected hit length — token
+        ids never leave the process, and the payload is bounded no
+        matter how large the tree grows."""
+        with self._lock:
+            nodes = []
+            stack = [(self._radix, 0)]
+            while stack:
+                node, depth = stack.pop()
+                for child in node.children.values():
+                    nodes.append((child, depth + 1))
+                    stack.append((child, depth + 1))
+            nodes.sort(key=lambda t: t[0].last_used, reverse=True)
+            entries = {child.chain_hash: depth * self.page_size
+                       for child, depth in nodes[:int(max_entries)]}
+            stats = dict(self._prefix_stats)
+            stats["cached_pages"] = len(self._tree_pages)
+            stats["nodes"] = len(nodes)
+        return {"page_size": self.page_size, "entries": entries,
+                "stats": stats}
+
+    def check_integrity(self):
+        """Debug invariant sweep (tests): every page is exactly one of
+        free/referenced, refcounts equal table + tree occurrences, and
+        the free list holds no duplicates.  Raises AssertionError."""
+        with self._lock:
+            counts = {}
+            for table in self._tables.values():
+                for p in table:
+                    counts[p] = counts.get(p, 0) + 1
+            for node in self._iter_nodes_locked():
+                counts[node.page] = counts.get(node.page, 0) + 1
+            assert counts == self._ref, \
+                f"refcount drift: counted {counts} vs {self._ref}"
+            assert len(self._free) == len(set(self._free)), \
+                "free list holds duplicates (double free)"
+            assert not (set(self._free) & set(counts)), \
+                "page both free and referenced"
+            assert len(self._free) + len(counts) == self.num_pages, \
+                "pages leaked: free + referenced != pool"
 
     # ---------------------------------------------------------- page table
     def page_table(self, seq_id, width=None):
@@ -136,23 +469,40 @@ class PagedKVCache:
         Long-running engines interleave alloc/free until the free list is
         scattered; compaction restores locality (sequential page ids DMA
         as one contiguous stream on TPU) and makes the pool's live set
-        checkpointable as a prefix slice.  One gather per pool array;
-        page tables are remapped in place.  Returns pages moved."""
-        order = []                   # new physical slot -> old page id
-        remap = {}                   # old page id -> new page id
-        for seq_id in self._tables:
-            for old in self._tables[seq_id]:
-                remap[old] = len(order)
-                order.append(old)
-        n_used = len(order)
-        moved = sum(1 for old, new in remap.items() if old != new)
-        if moved == 0:
-            return 0
-        order += [p for p in range(self.num_pages) if p not in remap]
-        idx = jnp.asarray(order, jnp.int32)
-        self.k_pages = jnp.take(self.k_pages, idx, axis=1)
-        self.v_pages = jnp.take(self.v_pages, idx, axis=1)
-        self._tables = {sid: [remap[p] for p in t]
-                        for sid, t in self._tables.items()}
-        self._free = list(range(self.num_pages - 1, n_used - 1, -1))
-        return moved
+        checkpointable as a prefix slice.  One gather per pool array.
+
+        Refcount-aware: a page shared by several page tables (a cached
+        prefix) — or held only by the radix tree — relocates exactly
+        ONCE, and every referencing table plus its tree node is updated
+        to the new id, so sequences sharing a prefix keep decoding
+        token-identically across a defrag.  Returns pages moved."""
+        with self._lock:
+            order = []               # new physical slot -> old page id
+            remap = {}               # old page id -> new page id
+            for seq_id in self._tables:
+                for old in self._tables[seq_id]:
+                    if old not in remap:
+                        remap[old] = len(order)
+                        order.append(old)
+            # cached-but-unreferenced prefix pages are live too: their
+            # contents are the cache
+            for node in self._iter_nodes_locked():
+                if node.page not in remap:
+                    remap[node.page] = len(order)
+                    order.append(node.page)
+            n_used = len(order)
+            moved = sum(1 for old, new in remap.items() if old != new)
+            if moved == 0:
+                return 0
+            order += [p for p in range(self.num_pages) if p not in remap]
+            idx = jnp.asarray(order, jnp.int32)
+            self.k_pages = jnp.take(self.k_pages, idx, axis=1)
+            self.v_pages = jnp.take(self.v_pages, idx, axis=1)
+            self._tables = {sid: [remap[p] for p in t]
+                            for sid, t in self._tables.items()}
+            for node in self._iter_nodes_locked():
+                node.page = remap[node.page]
+            self._ref = {remap[p]: c for p, c in self._ref.items()}
+            self._tree_pages = {remap[p] for p in self._tree_pages}
+            self._free = list(range(self.num_pages - 1, n_used - 1, -1))
+            return moved

@@ -11,7 +11,11 @@ process-wide registry); this module keeps the serving-shaped facade:
                  a streaming response's first byte)
   decode_token — per-token decode step time (steady-state speed)
   prefill_chunks — prompt chunks run through the unified step
-  page_occupancy — page-pool utilisation gauge, 0..1
+  page_occupancy — page-pool utilisation gauge, 0..1 (hard use only:
+                 evictable cached prefix pages count as free)
+  prefix_cache_* — radix prefix-cache hits / hit tokens / LRU
+                 evictions (counters) + cached pages (gauge): every
+                 hit token is prefill FLOPs the pool skipped
 
 Every metric is registered (serving_-prefixed) into the default
 MetricsRegistry with replace semantics, so rebuilding ``ServingMetrics``
@@ -72,6 +76,22 @@ class ServingMetrics:
             "serving_engine_healthy",
             help="1 = healthy (admitting), 0 = degraded (shedding)"))
         self.engine_healthy.set(1)
+        self.prefix_cache_hits = add(Counter(
+            "serving_prefix_cache_hits_total",
+            help="admissions whose prompt prefix was served from the "
+                 "radix cache (a refcount bump instead of prefill)"))
+        self.prefix_cache_evictions = add(Counter(
+            "serving_prefix_cache_evictions_total",
+            help="zero-ref cached prefix pages LRU-evicted to make "
+                 "room for new allocations"))
+        self.prefix_hit_tokens = add(Counter(
+            "serving_prefix_hit_tokens_total",
+            help="prompt tokens served from the prefix cache — each is "
+                 "one token of prefill FLOPs avoided"))
+        self.prefix_cache_pages = add(Gauge(
+            "serving_prefix_cache_pages",
+            help="pages currently held by the radix prefix cache "
+                 "(shared + evictable)"))
         self.prefill_tokens = add(Counter("serving_prefill_tokens_total"))
         self.prefill_chunks = add(Counter(
             "serving_prefill_chunks_total",
@@ -109,6 +129,12 @@ class ServingMetrics:
                 "prefill": self.prefill_tokens.value,
                 "prefill_chunks": self.prefill_chunks.value,
                 "generated": self.tokens_generated.value,
+            },
+            "prefix_cache": {
+                "hits": self.prefix_cache_hits.value,
+                "hit_tokens": self.prefix_hit_tokens.value,
+                "evictions": self.prefix_cache_evictions.value,
+                "cached_pages": self.prefix_cache_pages.value,
             },
             "queue_wait_s": self.queue_wait.summary(),
             "ttft_s": self.ttft.summary(),
@@ -173,6 +199,10 @@ class RouterMetrics:
             "router_backpressure_retries_total", labelnames=("replica",),
             help="dispatches deferred because the replica answered "
                  "RETRY_AFTER (router backs off by the drain hint)"))
+        self.cache_aware_dispatches = add(Counter(
+            "router_cache_aware_dispatches_total",
+            help="dispatches placed on a replica whose gossiped radix "
+                 "summary predicted a prefix-cache hit for the request"))
         self.drains = add(Counter(
             "router_drains_total", labelnames=("replica",),
             help="graceful drains started (rolling restarts)"))
@@ -213,6 +243,7 @@ class RouterMetrics:
             "failovers": self._family(self.failovers),
             "redispatched": self.redispatched.value,
             "backpressure_retries": self._family(self.backpressure_retries),
+            "cache_aware_dispatches": self.cache_aware_dispatches.value,
             "drains": self._family(self.drains),
             "restarts": self._family(self.restarts),
             "lost": self.lost.value,

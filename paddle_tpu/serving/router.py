@@ -10,6 +10,18 @@ machine-readable back-off hint — and this module is their consumer:
   with the smallest ``estimated_drain_s`` (queue depth breaks ties),
   so a slow or backlogged replica sheds traffic to its peers instead
   of growing an unbounded queue.
+- **cache-aware placement** — every replica publishes a bounded radix
+  summary of its prefix cache (chain hashes of cached page-aligned
+  prefixes + hit stats; :mod:`.prefix_gossip` rides the TCPStore plane
+  for cross-process fleets, in-process fleets pull
+  ``engine.prefix_summary()`` directly).  Dispatch scores each
+  candidate by ``drain − expected_hit_tokens × cache_hit_token_s``:
+  a request whose system prompt is warm on replica 2 goes there even
+  when replica 1 is marginally less drained — the prefill FLOPs
+  avoided outweigh the wait.  The summary is advisory: the chosen
+  replica re-walks its OWN tree at admission (failover re-dispatches
+  included), so stale gossip can only cost FLOPs, never correctness
+  or the exactly-once guarantee.
 - **backpressure, not hammering** — a replica answering RETRY_AFTER is
   put in a per-replica back-off window: ``max(retry_after_s hint,
   jittered exponential delay)`` capped at ``backoff_cap_s`` (the delay
@@ -65,6 +77,7 @@ from collections import deque
 from ..observability.tracing import Tracer, default_tracer
 from ..resilience.retry import backoff_delays
 from .engine import Engine, RequestState, SamplingParams
+from .kv_cache import prefix_hashes
 from .metrics import RouterMetrics
 
 __all__ = ["FleetRouter", "FleetRequest", "FleetRequestState",
@@ -174,6 +187,15 @@ class FleetRouter:
     restart drain budget; ``warmup`` (a callable taking an Engine) runs
     on every factory-rebuilt engine before it re-enters rotation, so a
     restarted replica doesn't serve its first request cold.
+
+    Cache-aware placement: ``cache_aware`` (default on) folds each
+    replica's expected prefix-cache hit into the dispatch score at
+    ``cache_hit_token_s`` seconds of credit per hit token.
+    ``prefix_summary_source`` (a zero-arg callable returning
+    ``{replica_id: summary}``, e.g.
+    :func:`~paddle_tpu.serving.prefix_gossip.collect_prefix_summaries`
+    bound to a TCPStore) replaces the default in-process
+    ``engine.prefix_summary()`` pull — the cross-host gossip path.
     ``clock``/``tracer``/``registry`` mirror the engine's injection
     points."""
 
@@ -181,7 +203,8 @@ class FleetRouter:
                  breaker_threshold=1, probe_miss_threshold=2,
                  stall_timeout_s=0.25, backoff_base_s=0.05,
                  backoff_cap_s=2.0, drain_deadline_s=5.0, warmup=None,
-                 rng=None):
+                 cache_aware=True, cache_hit_token_s=0.01,
+                 prefix_summary_source=None, rng=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.warmup = warmup
@@ -197,6 +220,15 @@ class FleetRouter:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_cap_s = float(backoff_cap_s)
         self.drain_deadline_s = float(drain_deadline_s)
+        # cache-aware dispatch: score replicas by expected prefix-hit
+        # length jointly with the drain estimate.  Each hit token is
+        # worth ``cache_hit_token_s`` seconds of avoided prefill in the
+        # score (default ~one assumed decode-step per token), so a warm
+        # replica beats an equally-drained cold one but a deeply
+        # backlogged warm replica still loses to an idle cold peer.
+        self.cache_aware = bool(cache_aware)
+        self.cache_hit_token_s = float(cache_hit_token_s)
+        self._summary_source = prefix_summary_source
         self._rng = rng or random
         self.replicas = []
         for item in replicas:
@@ -218,6 +250,10 @@ class FleetRouter:
         # guarded-by: self._lock
         self._assigned = {rep.replica_id: {} for rep in self.replicas}
         self._next_id = 0           # guarded-by: self._lock
+        # per-replica radix gossip: the freshest bounded prefix summary
+        # each replica published (direct engine pull, or a TCPStore
+        # collector via prefix_summary_source)
+        self._prefix_summaries = {}  # guarded-by: self._lock
         self._update_gauges()
 
     # ------------------------------------------------------------- lookup
@@ -351,6 +387,49 @@ class FleetRouter:
         span.end()
         self._update_gauges()
 
+    # ---------------------------------------------------- prefix gossip
+    def _refresh_prefix_summaries(self):
+        """Pull the freshest per-replica radix summaries: from the
+        configured gossip source (a TCPStore collector) when one is
+        wired, else straight off each live engine.  A replica whose
+        summary can't be fetched keeps its previous one — stale gossip
+        only mis-scores a dispatch, it never blocks one."""
+        if self._summary_source is not None:
+            try:
+                fresh = dict(self._summary_source())
+            except Exception:   # silent-ok: stale gossip is tolerated —
+                return          # scoring falls back to the last summaries
+        else:
+            fresh = {}
+            for rep in self.replicas:
+                if rep.state != ReplicaState.HEALTHY:
+                    continue
+                try:
+                    fresh[rep.replica_id] = rep.engine.prefix_summary()
+                except (OSError, AttributeError):
+                    continue    # dead/foreign engine: keep what we had
+        with self._lock:
+            self._prefix_summaries.update(fresh)
+
+    def _expected_hit_tokens_locked(self, tokens, replica_id):
+        """Expected prefix-cache hit length (tokens) of an admission
+        carrying ``tokens`` on ``replica_id``, from its gossiped
+        summary: hash the prompt's page-aligned prefixes client-side
+        and take the deepest hash the replica's radix summary knows.
+        Caller holds ``self._lock`` (summaries are shared state)."""
+        summary = self._prefix_summaries.get(replica_id)
+        if not summary or not summary.get("enabled", True):
+            return 0
+        entries = summary.get("entries") or {}
+        if not entries:
+            return 0
+        page_size = int(summary.get("page_size") or 16)
+        best = 0
+        for i, h in enumerate(prefix_hashes(tokens, page_size)):
+            if h in entries:
+                best = (i + 1) * page_size
+        return min(best, max(len(tokens) - 1, 0))
+
     # -------------------------------------------------------------- admit
     def _can_admit(self, rep, now):
         return rep.state == ReplicaState.HEALTHY and now >= rep.not_before
@@ -370,12 +449,15 @@ class FleetRouter:
             replica=str(rep.replica_id)).inc()
         return delay
 
-    def _dispatch_locked(self, freq, rep, now):
+    def _dispatch_locked(self, freq, rep, now, expected_hit=0):
         """Try the queue-head request on ``rep`` (caller holds
         ``self._lock`` — the ``_admit`` loop owns the queue while it
-        places work).  Returns one of "dispatched" / "backpressure" /
-        "rejected" / "evicted" / "failed" (replica, not request, at
-        fault)."""
+        places work).  ``expected_hit`` is the gossip-predicted prefix
+        hit length that steered the placement (telemetry only — the
+        target replica re-walks its own tree at admission, so a stale
+        prediction costs FLOPs, never correctness).  Returns one of
+        "dispatched" / "backpressure" / "rejected" / "evicted" /
+        "failed" (replica, not request, at fault)."""
         already = len(freq.tokens_out)
         kw = {"max_new_tokens": freq.sampling.max_new_tokens - already}
         if freq.deadline is not None:
@@ -414,10 +496,13 @@ class FleetRouter:
         self._assigned[rep.replica_id][freq.id] = freq
         rep.backoff = None                   # successful admission resets
         self.metrics.dispatches.labels(replica=str(rep.replica_id)).inc()
+        if expected_hit > 0:
+            self.metrics.cache_aware_dispatches.inc()
         self.tracer.start_trace(
             "router::dispatch", start_s=now,
             attributes={"request_id": freq.id,
                         "replica": rep.replica_id,
+                        "expected_prefix_hit_tokens": expected_hit,
                         "redispatch": freq.redispatches > 0}).end(now)
         if stalled:
             # admission wedge (serving.admit stall site): the request IS
@@ -426,12 +511,18 @@ class FleetRouter:
         return "dispatched"
 
     def _admit(self, now):
-        """Place queued requests on the lowest-drain admittable replica;
-        a backpressuring or failing replica is skipped for the rest of
-        this tick."""
+        """Place queued requests on the best admittable replica.  The
+        score is the drain estimate MINUS the expected prefix-cache
+        credit (hit tokens x cache_hit_token_s): the fleet routes a
+        shared-system-prompt request to the replica already holding its
+        prefix unless that replica's backlog outweighs the prefill it
+        would save.  A backpressuring or failing replica is skipped for
+        the rest of this tick."""
         skip = set()
         with self._lock:
             while self._pending:
+                head = self._pending[0]
+                admission_tokens = head.prompt + head.tokens_out
                 cands = []
                 for rep in self.replicas:
                     if rep.replica_id in skip or \
@@ -442,17 +533,21 @@ class FleetRouter:
                     except OSError as e:
                         self._on_replica_failure(rep, "probe", e)
                         continue
+                    drain = float(h.get("estimated_drain_s") or 0.0)
+                    hit = (self._expected_hit_tokens_locked(
+                        admission_tokens, rep.replica_id)
+                        if self.cache_aware else 0)
                     cands.append(
-                        (float(h.get("estimated_drain_s") or 0.0),
+                        (drain - hit * self.cache_hit_token_s,
                          (h.get("queue_depth") or 0)
                          + (h.get("running") or 0),
-                         rep.replica_id, rep))
+                         rep.replica_id, rep, hit))
                 if not cands:
                     break
                 cands.sort(key=lambda c: c[:3])
-                rep = cands[0][3]
-                status = self._dispatch_locked(self._pending[0], rep,
-                                               now)
+                rep, hit = cands[0][3], cands[0][4]
+                status = self._dispatch_locked(head, rep, now,
+                                               expected_hit=hit)
                 if status in ("backpressure", "failed"):
                     skip.add(rep.replica_id)
             self.metrics.pending_depth.set(len(self._pending))
@@ -593,6 +688,11 @@ class FleetRouter:
                 rep.probe_misses += 1
                 if rep.probe_misses >= self.probe_miss_threshold:
                     self._on_replica_failure(rep, "probe", e)
+        if self.cache_aware:
+            # refresh the radix gossip before placement so this tick's
+            # admissions (failover re-dispatches included) score
+            # against each target replica's current tree
+            self._refresh_prefix_summaries()
         self._admit(now)
         self._update_gauges()
         return finished
@@ -670,8 +770,16 @@ class FleetRouter:
                     entry["engine"] = rep.engine.health()
                 except OSError as e:
                     entry["engine"] = {"error": repr(e)}
+                summary = self._prefix_summaries.get(rep.replica_id)
+                if summary is not None:
+                    entry["prefix_cache"] = {
+                        "enabled": summary.get("enabled", True),
+                        "summary_entries": len(summary.get("entries")
+                                               or {}),
+                        **(summary.get("stats") or {})}
                 per[str(rep.replica_id)] = entry
             out = self.fleet_health()
             out["replicas"] = per
+            out["cache_aware"] = self.cache_aware
             out["counters"] = self.metrics.snapshot()
             return out

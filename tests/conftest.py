@@ -27,8 +27,31 @@ import paddle_tpu  # noqa: E402,F401
 # equivalent is @pytest.mark.slow + this cache)
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# persist ONLY the genuinely expensive jitted step programs (hapi
+# train/eval steps, the serving unified step) — the entries whose
+# mid-process deserialization has years of green runs behind it.
+# Eager primitives (most of all the per-call lax.scan of an eager
+# gpt_forward: each call builds a fresh body closure -> fresh jaxpr ->
+# in-memory cache miss -> disk read) must NOT be persisted: XLA:CPU's
+# deserialize_executable reproducibly segfaults on those reads late in
+# a long suite in this environment (same machine-feature problem
+# family as the AOT-blob note below).  Recompiling them costs
+# milliseconds per test; deserializing them kills the whole run.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from jax._src import compilation_cache as _cc  # noqa: E402
+
+_orig_put = _cc.put_executable_and_time
+
+
+def _selective_put(cache_key, module_name, executable, backend,
+                   compile_time):
+    if module_name.startswith(("jit_step", "jit__step")):
+        _orig_put(cache_key, module_name, executable, backend,
+                  compile_time)
+
+
+_cc.put_executable_and_time = _selective_put
 # keep XLA:CPU AOT blobs out of the cache: reloading them trips a
 # machine-feature check (prefer-no-scatter/-gather) and spams stderr
 jax.config.update("jax_persistent_cache_enable_xla_caches", "none")

@@ -34,11 +34,28 @@ def tiny_model():
     return cfg, params
 
 
+# one stable jitted forward per config: an EAGER gpt_forward builds a
+# fresh scan closure (fresh jaxpr) per call, so every oracle step would
+# compile a brand-new executable — churning jax's bounded eager cache
+# and the process mmap budget across a long suite.  With a stable jit
+# identity each [1, L] compiles exactly once per process.
+_ORACLE_FWD = {}
+
+
+def _oracle_forward(cfg):
+    fn = _ORACLE_FWD.get(id(cfg))
+    if fn is None:
+        fn = _ORACLE_FWD.setdefault(
+            id(cfg), jax.jit(lambda p, t: gpt_forward(cfg, p, t)))
+    return fn
+
+
 def naive_generate(cfg, params, prompt, n_new):
     """Full-recompute greedy decoding — the correctness oracle."""
+    fwd = _oracle_forward(cfg)
     toks = list(prompt)
     for _ in range(n_new):
-        logits = gpt_forward(cfg, params, jnp.asarray([toks], jnp.int32))
+        logits = fwd(params, jnp.asarray([toks], jnp.int32))
         toks.append(int(jnp.argmax(logits[0, -1])))
     return toks[len(prompt):]
 
@@ -858,6 +875,274 @@ class TestEvacuate:
             SamplingParams(max_new_tokens=10 - len(emitted)))[0]
         assert emitted + rest == full
         assert req is got
+
+
+# ------------------------------------------------------- prefix cache
+
+
+class TestPrefixCache:
+    """Radix/prefix KV reuse: a shared prompt prefix becomes a refcount
+    bump instead of prefill FLOPs — never a correctness change.  The
+    parity oracle is the same full-recompute greedy decode every other
+    engine test uses."""
+
+    def _prompts(self, cfg, sys_len=12, tail_len=5, n_tails=2, seed=41):
+        rng = np.random.RandomState(seed)
+        system = [int(t) for t in rng.randint(0, cfg.vocab_size, sys_len)]
+        tails = [[int(t) for t in rng.randint(0, cfg.vocab_size, tail_len)]
+                 for _ in range(n_tails)]
+        return system, tails
+
+    # ---- cache-level mechanics -----------------------------------------
+    def test_attach_refcounts_and_cow(self):
+        c = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                         num_pages=16, page_size=4, max_seq_len=64)
+        toks = list(range(12))                   # 3 full pages
+        assert c.allocate("a", 12)
+        c.insert_prefix("a", toks)
+        c.free("a")
+        # cached pages are evictable, so the whole pool stays allocatable
+        assert c.num_free_pages == 16
+        assert c.prefix_stats()["cached_pages"] == 3
+        c.check_integrity()
+        # partial-prefix hit: 3 shared pages + 1 fresh for the tail
+        m = c.allocate_prefixed("b", toks + [99, 98], chunk_tokens=4)
+        assert m == 12
+        shared = c.page_table("b")[:3]
+        c.check_integrity()
+        # full-prompt hit: matched is capped at len-1 and the final
+        # page is COPIED, not shared — writes never land on shared pages
+        m = c.allocate_prefixed("cw", toks, chunk_tokens=4)
+        assert m == 11
+        cow_table = c.page_table("cw")[:3]
+        assert cow_table[:2] == shared[:2]       # prefix shared
+        assert cow_table[2] != shared[2]         # final page is a copy
+        np.testing.assert_array_equal(
+            np.asarray(c.k_pages[:, cow_table[2]]),
+            np.asarray(c.k_pages[:, shared[2]]))
+        c.check_integrity()
+        # free decrements; double-free impossible, cache intact
+        c.free("b")
+        c.free("cw")
+        c.check_integrity()
+        assert c.prefix_stats()["cached_pages"] == 3
+        assert c.num_free_pages == 16
+
+    def test_miss_returns_cold_and_shortage_rolls_back(self):
+        c = PagedKVCache(num_layers=1, num_heads=1, head_dim=2,
+                         num_pages=4, page_size=4, max_seq_len=16)
+        assert c.allocate_prefixed("a", list(range(9)), 4) == 0  # cold
+        # pool exhausted even after eviction: None, nothing moved
+        assert c.allocate_prefixed("b", list(range(20, 36)), 16) is None
+        assert "b" not in c.seq_ids()
+        c.check_integrity()
+
+    # ---- engine parity --------------------------------------------------
+    def test_cache_hit_greedy_parity_and_metrics(self, tiny_model):
+        """A request sharing a finished request's prefix prefills only
+        its tail, and its greedy output equals a cold run's."""
+        cfg, params = tiny_model
+        system, tails = self._prompts(cfg)
+        eng = Engine(cfg, params, page_size=4, num_pages=64,
+                     max_batch_size=2, chunk_len=4)
+        sp = SamplingParams(max_new_tokens=6)
+        a = eng.add_request(system + tails[0], sp)
+        while eng.has_work():
+            eng.step()
+        assert a.output == naive_generate(cfg, params, system + tails[0], 6)
+        chunks_cold = eng.metrics.prefill_chunks.value
+        b = eng.add_request(system + tails[1], sp)
+        while eng.has_work():
+            eng.step()
+        assert b.output == naive_generate(cfg, params, system + tails[1], 6)
+        snap = eng.metrics.snapshot()["prefix_cache"]
+        assert snap["hits"] == 1
+        assert snap["hit_tokens"] >= len(system) - eng.cache.page_size
+        assert snap["cached_pages"] > 0
+        # the hit skipped prefill work: fewer chunks than the cold run
+        assert eng.metrics.prefill_chunks.value - chunks_cold < chunks_cold
+        eng.cache.check_integrity()
+
+    def test_full_prompt_hit_cow_parity(self, tiny_model):
+        """An identical page-aligned prompt re-runs exactly one token
+        through a copied final page — and decodes identically, without
+        corrupting the original's cached pages for a third request."""
+        cfg, params = tiny_model
+        system, _ = self._prompts(cfg, sys_len=16, seed=43)  # 4 pages
+        ref = naive_generate(cfg, params, system, 6)
+        eng = Engine(cfg, params, page_size=4, num_pages=64,
+                     max_batch_size=2, chunk_len=4)
+        sp = SamplingParams(max_new_tokens=6)
+        outs = [eng.generate([system], sp)[0] for _ in range(3)]
+        assert outs == [ref, ref, ref]
+        stats = eng.cache.prefix_stats()
+        assert stats["hits"] == 2
+        assert stats["hit_tokens"] == 2 * (len(system) - 1)  # COW cap
+        eng.cache.check_integrity()
+
+    def test_hit_mid_chunk_parity(self, tiny_model):
+        """A cached prefix whose end is NOT a chunk boundary: prefill
+        resumes mid-chunk at the first uncached token."""
+        cfg, params = tiny_model
+        # page 4, chunk 8: a 12-token cached prefix starts the tail
+        # chunk at offset 12 % 8 == 4 — mid-chunk
+        system, tails = self._prompts(cfg, sys_len=12, tail_len=9,
+                                      seed=47)
+        eng = Engine(cfg, params, page_size=4, num_pages=64,
+                     max_batch_size=2, chunk_len=8)
+        sp = SamplingParams(max_new_tokens=6)
+        eng.generate([system + tails[0]], sp)
+        b = eng.add_request(system + tails[1], sp)
+        eng.step()
+        assert b.prompt_pos > 12            # resumed past the cached part
+        while eng.has_work():
+            eng.step()
+        assert b.output == naive_generate(cfg, params,
+                                          system + tails[1], 6)
+        assert eng.cache.prefix_stats()["hits"] == 1
+
+    def test_prefix_cache_off_is_cold(self, tiny_model):
+        cfg, params = tiny_model
+        system, tails = self._prompts(cfg)
+        eng = Engine(cfg, params, page_size=4, num_pages=64,
+                     max_batch_size=2, chunk_len=4, prefix_cache=False)
+        sp = SamplingParams(max_new_tokens=4)
+        eng.generate([system + tails[0], system + tails[1]], sp)
+        stats = eng.cache.prefix_stats()
+        assert stats["hits"] == 0 and stats["cached_pages"] == 0
+        assert eng.health()["prefix_cache"]["enabled"] is False
+
+    # ---- eviction / watermark integration ------------------------------
+    def test_lru_eviction_under_pressure_never_sheds(self, tiny_model):
+        """A pool full of zero-ref cached prefixes must neither trip
+        the occupancy watermark (no RETRY_AFTER storm from a warm
+        cache) nor block admission: allocation LRU-evicts."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(53)
+        eng = Engine(cfg, params, page_size=4, num_pages=8,
+                     max_batch_size=1, chunk_len=8,
+                     shed_occupancy_high=0.5)
+        sp = SamplingParams(max_new_tokens=2)
+        # two 16-token prompts fill all 8 pages with cached prefixes
+        for _ in range(2):
+            p = [int(t) for t in rng.randint(0, cfg.vocab_size, 15)]
+            eng.generate([p], sp)
+        assert eng.cache.prefix_stats()["cached_pages"] >= 6
+        assert eng.cache.occupancy() == 0.0      # all evictable = free
+        fresh = [int(t) for t in rng.randint(0, cfg.vocab_size, 15)]
+        req = eng.add_request(fresh, sp)
+        assert req.state == RequestState.QUEUED  # NOT shed
+        while eng.has_work():
+            eng.step()
+        assert req.state == RequestState.FINISHED
+        assert req.output == naive_generate(cfg, params, fresh, 2)
+        assert eng.metrics.snapshot()["prefix_cache"]["evictions"] > 0
+        assert eng.metrics.requests_shed.value == 0
+        eng.cache.check_integrity()
+
+    def test_mid_prefill_deadline_eviction_decrements_shared_pages(
+            self, tiny_model):
+        """The PR 7 eviction regression, extended: a request evicted
+        mid-prefill whose already-written chunks include SHARED cached
+        pages must DECREMENT them (the cache and its other users
+        survive), not force-free them."""
+        cfg, params = tiny_model
+        system, tails = self._prompts(cfg, sys_len=12, tail_len=10,
+                                      seed=59)
+        clk = _ManualClock()
+        eng = Engine(cfg, params, page_size=4, num_pages=32,
+                     max_batch_size=2, chunk_len=4, clock=clk)
+        sp = SamplingParams(max_new_tokens=4)
+        a = eng.add_request(system + tails[0], sp)
+        while eng.has_work():
+            eng.step()
+        cached = eng.cache.prefix_stats()["cached_pages"]
+        assert cached > 0
+        # B rides the cached prefix, then dies mid-prefill
+        b = eng.add_request(system + tails[1],
+                            SamplingParams(max_new_tokens=4, ttl_s=5.0))
+        clk.advance(1.0)
+        eng.step()
+        assert b.prompt_pos > 12 and b.prompt_pos < len(b.prompt)
+        clk.advance(10.0)
+        done = eng.step()
+        assert b in done and b.state == RequestState.EVICTED
+        # shared pages survived the eviction: no double-free, cache
+        # intact, and a third request still hits it with exact parity
+        eng.cache.check_integrity()
+        assert eng.cache.prefix_stats()["cached_pages"] >= cached
+        assert eng.cache.num_free_pages == eng.cache.num_pages
+        c = eng.add_request(system + tails[0], sp)
+        while eng.has_work():
+            eng.step()
+        assert c.output == a.output
+        assert eng.cache.prefix_stats()["hits"] >= 2
+        eng.cache.check_integrity()
+
+    # ---- defrag (satellite) --------------------------------------------
+    def test_defrag_with_shared_prefix_decodes_token_identically(
+            self, tiny_model):
+        """Refcount-aware defrag: a page shared by two page tables (and
+        the radix tree) relocates ONCE with every referencing table
+        updated — both sequences keep decoding token-identically."""
+        cfg, params = tiny_model
+        system, tails = self._prompts(cfg, sys_len=12, tail_len=6,
+                                      seed=61)
+        eng = Engine(cfg, params, page_size=4, num_pages=64,
+                     max_batch_size=2, chunk_len=16)
+        sp = SamplingParams(max_new_tokens=10)
+        # a placeholder allocation pins the low-index pages, so the
+        # cached prefix and both sequences land above it — freeing it
+        # later leaves the hole defrag must compact over
+        eng.cache.allocate("hole", 16)
+        eng.generate([system + [7, 7, 7]], SamplingParams(max_new_tokens=2))
+        # two live sequences sharing the cached system prefix
+        b = eng.add_request(system + tails[0], sp)
+        c = eng.add_request(system + tails[1], sp)
+        for _ in range(3):
+            eng.step()
+        assert b.output and c.output           # both mid-decode
+        tb = eng.cache.page_table(b.id)[:3]
+        assert tb[:3] == eng.cache.page_table(c.id)[:3]  # 2-way shared
+        eng.cache.free("hole")                 # hole below everything
+        moved = eng.cache.defrag()
+        assert moved > 0
+        assert eng.cache.page_table(b.id)[:3] != tb  # shared pages moved
+        eng.cache.check_integrity()
+        # the shared prefix relocated once: tables still agree
+        assert eng.cache.page_table(b.id)[:3] == \
+            eng.cache.page_table(c.id)[:3]
+        while eng.has_work():
+            eng.step()
+        assert b.output == naive_generate(cfg, params, system + tails[0],
+                                          10)
+        assert c.output == naive_generate(cfg, params, system + tails[1],
+                                          10)
+        eng.cache.check_integrity()
+
+    # ---- gossip surface -------------------------------------------------
+    def test_prefix_summary_bounded_and_hashes_roundtrip(self, tiny_model):
+        """The bounded radix summary names exactly the prefixes that
+        prefix_hashes() computes client-side — the gossip protocol's
+        two halves agree."""
+        from paddle_tpu.serving import prefix_hashes
+
+        cfg, params = tiny_model
+        system, tails = self._prompts(cfg, sys_len=16, seed=67)
+        eng = Engine(cfg, params, page_size=4, num_pages=64,
+                     max_batch_size=2, chunk_len=8)
+        eng.generate([system + tails[0]], SamplingParams(max_new_tokens=2))
+        assert len(eng.prefix_summary(max_entries=3)["entries"]) <= 3
+        summary = eng.prefix_summary()
+        assert summary["enabled"] is True
+        assert summary["stats"]["cached_pages"] > 0
+        hashes = prefix_hashes(system + tails[1], summary["page_size"])
+        depths = [(i + 1) * summary["page_size"]
+                  for i, h in enumerate(hashes)
+                  if h in summary["entries"]]
+        assert depths and max(depths) >= 16      # the shared system part
+        for h, depth in summary["entries"].items():
+            assert depth % summary["page_size"] == 0
 
 
 # --------------------------------------------------- satellite regressions
