@@ -50,6 +50,7 @@ lock-discipline pass).
 from __future__ import annotations
 
 import hashlib
+import heapq
 import math
 import threading
 
@@ -143,7 +144,16 @@ class PagedKVCache:
         self._lock = threading.RLock()
         self._ref = {}             # page -> refcount  # guarded-by: self._lock
         self._radix = _PrefixNode((), None, None, _ROOT_HASH, 0)  # guarded-by: self._lock
-        self._tree_pages = set()   # pages held by radix nodes  # guarded-by: self._lock
+        self._tree_pages = {}      # page -> its radix node  # guarded-by: self._lock
+        # evictable pages (tree-held, refcount 1) are counted
+        # incrementally — num_free_pages/occupancy sit on every
+        # admission check and must not walk the tree
+        self._evictable = 0        # guarded-by: self._lock
+        # lazy min-heap of (last_used, seq, node) eviction candidates;
+        # stale entries (touched/bumped/detached nodes) are skipped at
+        # pop time, so eviction is O(log heap) not O(tree)
+        self._evict_heap = []      # guarded-by: self._lock
+        self._heap_seq = 0         # guarded-by: self._lock
         # monotonic counters for the serving_prefix_* metrics (the
         # engine syncs deltas each step)
         self._prefix_stats = {"hits": 0, "hit_tokens": 0,
@@ -238,16 +248,49 @@ class PagedKVCache:
             self._free = list(range(self.num_pages - 1, -1, -1))
             self._ref = {}
             self._radix = _PrefixNode((), None, None, _ROOT_HASH, 0)
-            self._tree_pages = set()
+            self._tree_pages = {}
+            self._evictable = 0
+            self._evict_heap = []
             self.k_pages = jnp.zeros_like(self.k_pages)
             self.v_pages = jnp.zeros_like(self.v_pages)
 
     # --------------------------------------------------- locked internals
     def _release_page_locked(self, page):
         self._ref[page] -= 1
-        if self._ref[page] == 0:
+        count = self._ref[page]
+        if count == 0:
             del self._ref[page]
             self._free.append(page)
+        elif count == 1:
+            node = self._tree_pages.get(page)
+            if node is not None:      # tree-only now: became evictable
+                self._evictable += 1
+                if not node.children:
+                    self._note_evictable_locked(node)
+
+    def _bump_ref_locked(self, page):
+        count = self._ref.get(page, 0)
+        self._ref[page] = count + 1
+        if count == 1 and page in self._tree_pages:
+            self._evictable -= 1      # referenced again: no longer evictable
+
+    def _note_evictable_locked(self, node):
+        """Push ``node`` as an eviction candidate at its current
+        ``last_used``.  Lazy: a later touch/bump/detach makes the entry
+        stale, detected (and skipped) at pop time.  Compacts the heap
+        when stale entries dominate so it stays O(tree)-sized."""
+        self._heap_seq += 1
+        heapq.heappush(self._evict_heap,
+                       (node.last_used, self._heap_seq, node))
+        if len(self._evict_heap) > 4 * (len(self._tree_pages) + 16):
+            live = {}
+            for entry in self._evict_heap:
+                last_used, _, cand = entry
+                if (cand.last_used == last_used and not cand.children
+                        and self._tree_pages.get(cand.page) is cand
+                        and self._ref.get(cand.page) == 1):
+                    live[id(cand)] = entry
+            self._evict_heap = sorted(live.values())
 
     def _take_pages_locked(self, need):
         """Pop ``need`` pages (refcount 1 each), LRU-evicting zero-ref
@@ -265,8 +308,10 @@ class PagedKVCache:
         """Cached pages reclaimable by eviction: tree-held with no
         sequence reference.  A sequence referencing a node references
         every ancestor too, so refcount-1 tree pages always form
-        evictable (leaf-first) subtrees."""
-        return sum(1 for p in self._tree_pages if self._ref.get(p) == 1)
+        evictable (leaf-first) subtrees.  Maintained incrementally on
+        refcount 1<->2 transitions and insert/evict — this sits behind
+        num_free_pages/occupancy on every admission check."""
+        return self._evictable
 
     def _iter_nodes_locked(self):
         stack = list(self._radix.children.values())
@@ -278,20 +323,26 @@ class PagedKVCache:
     def _evict_one_locked(self):
         """Evict the least-recently-used zero-ref LEAF node (leaf-only:
         an inner node's page is the prefix its cached descendants
-        attend through).  Returns True when a page was reclaimed."""
-        victim = None
-        for node in self._iter_nodes_locked():
-            if node.children or self._ref.get(node.page) != 1:
-                continue
-            if victim is None or node.last_used < victim.last_used:
-                victim = node
-        if victim is None:
-            return False
-        victim.parent.children.pop(victim.key)
-        self._tree_pages.discard(victim.page)
-        self._release_page_locked(victim.page)
-        self._prefix_stats["evictions"] += 1
-        return True
+        attend through).  Pops the lazy candidate heap, skipping stale
+        entries.  Returns True when a page was reclaimed."""
+        while self._evict_heap:
+            last_used, _, victim = heapq.heappop(self._evict_heap)
+            if (victim.last_used != last_used or victim.children
+                    or self._tree_pages.get(victim.page) is not victim
+                    or self._ref.get(victim.page) != 1):
+                continue              # stale entry
+            parent = victim.parent
+            parent.children.pop(victim.key)
+            del self._tree_pages[victim.page]
+            self._evictable -= 1
+            self._release_page_locked(victim.page)
+            self._prefix_stats["evictions"] += 1
+            # the parent may have just become an evictable leaf itself
+            if (parent is not self._radix and not parent.children
+                    and self._ref.get(parent.page) == 1):
+                self._note_evictable_locked(parent)
+            return True
+        return False
 
     def _match_locked(self, token_ids):
         """Longest cached page-aligned prefix of token_ids: the radix
@@ -306,6 +357,9 @@ class PagedKVCache:
             if child is None:
                 break
             child.last_used = self._tick
+            if not child.children and self._ref.get(child.page) == 1:
+                # touch stales the old heap entry; re-arm at the new tick
+                self._note_evictable_locked(child)
             pages.append(child.page)
             node = child
         return pages
@@ -324,6 +378,14 @@ class PagedKVCache:
         write**: the copy receives the last prompt token's K/V, the
         shared original is never written.
 
+        The matched chain is pinned (refcount-bumped) before fresh
+        pages are taken, so allocation-pressure eviction can never
+        reclaim the very pages being attached.  When a deep match would
+        starve its own admission — the matched pages ARE most of the
+        evictable pool — the match is shrunk a page at a time (each
+        dropped page becomes evictable again), trading hit length for
+        admissibility down to a cold admission.
+
         Returns the number of prompt tokens served from cache (0 = cold
         admission), or None — nothing allocated, no refcount moved —
         when the pool can't cover the request even after evicting every
@@ -332,26 +394,52 @@ class PagedKVCache:
             raise ValueError(f"seq {seq_id!r} already allocated")
         n = len(token_ids)
         with self._lock:
-            shared = self._match_locked(token_ids)
-            cow_src = None
-            if shared and len(shared) * self.page_size >= n:
-                # fully cached: COW the final page, re-run its last token
-                cow_src = shared[-1]
-                shared = shared[:-1]
-                matched = n - 1
-            else:
-                matched = len(shared) * self.page_size
-            cover = min(matched + max(1, int(chunk_tokens)), n)
-            need = self.pages_for(cover)
-            if need > self.max_pages_per_seq:
-                raise ValueError(
-                    f"seq {seq_id!r}: {cover} tokens need {need} pages > "
-                    f"max_pages_per_seq {self.max_pages_per_seq}")
-            fresh = self._take_pages_locked(need - len(shared))
-            if fresh is None:
-                return None
-            for p in shared:
-                self._ref[p] += 1
+            full_match = self._match_locked(token_ids)
+            keep = len(full_match)
+            while True:
+                shared = full_match[:keep]
+                cow_src = None
+                if shared and len(shared) * self.page_size >= n:
+                    # fully cached: COW the final page, re-run its last
+                    # token
+                    cow_src = shared[-1]
+                    shared = shared[:-1]
+                    matched = n - 1
+                else:
+                    matched = len(shared) * self.page_size
+                cover = min(matched + max(1, int(chunk_tokens)), n)
+                need = self.pages_for(cover)
+                if need > self.max_pages_per_seq:
+                    raise ValueError(
+                        f"seq {seq_id!r}: {cover} tokens need {need} "
+                        f"pages > max_pages_per_seq "
+                        f"{self.max_pages_per_seq}")
+                # PIN the matched chain (and the COW source) BEFORE
+                # taking fresh pages: _take_pages_locked may LRU-evict
+                # zero-ref tree leaves, and an unpinned match is exactly
+                # such a leaf chain — without the bump, eviction could
+                # free a matched page and hand it straight back as
+                # "fresh" for this same sequence (one physical page at
+                # two logical positions: prefill writes would corrupt
+                # the cached prefix).
+                pinned = list(shared)
+                if cow_src is not None:
+                    pinned.append(cow_src)
+                for p in pinned:
+                    self._bump_ref_locked(p)
+                fresh = self._take_pages_locked(need - len(shared))
+                if fresh is not None:
+                    break
+                for p in pinned:      # unwind this attempt: no
+                    self._release_page_locked(p)  # refcount moved
+                if keep == 0:
+                    return None       # nothing allocated
+                # a pinned match is unevictable, so a deep match can
+                # starve its own admission — shrink it one page at a
+                # time (the dropped tail becomes evictable again),
+                # trading cache reuse for allocatable pages, down to a
+                # cold admission before giving up
+                keep -= 1
             if cow_src is not None:
                 # one-page copy-on-write; cow page is fresh[0] (owned)
                 dst = fresh[0]
@@ -359,6 +447,8 @@ class PagedKVCache:
                     self.k_pages[:, cow_src])
                 self.v_pages = self.v_pages.at[:, dst].set(
                     self.v_pages[:, cow_src])
+                # copy landed; the source keeps only its tree/table refs
+                self._release_page_locked(cow_src)
             self._tables[seq_id] = shared + fresh
             if matched:
                 self._prefix_stats["hits"] += 1
@@ -391,12 +481,20 @@ class PagedKVCache:
                         key, page, node,
                         _chunk_hash(node.chain_hash, key), self._tick)
                     node.children[key] = child
+                    # bump precedes tree entry: the inserting sequence's
+                    # table already holds the page, so post-bump ref >= 2
+                    # and the new node is never immediately evictable
                     self._ref[page] = self._ref.get(page, 0) + 1
-                    self._tree_pages.add(page)
+                    self._tree_pages[page] = child
                     self._prefix_stats["inserted_pages"] += 1
                     added += 1
                 else:
                     child.last_used = self._tick
+                    if (not child.children
+                            and self._ref.get(child.page) == 1):
+                        # another sequence's since-freed page: the touch
+                        # stales its heap entry, re-arm at the new tick
+                        self._note_evictable_locked(child)
                 node = child
         return added
 
@@ -435,8 +533,10 @@ class PagedKVCache:
 
     def check_integrity(self):
         """Debug invariant sweep (tests): every page is exactly one of
-        free/referenced, refcounts equal table + tree occurrences, and
-        the free list holds no duplicates.  Raises AssertionError."""
+        free/referenced, refcounts equal table + tree occurrences, the
+        free list holds no duplicates, the incremental evictable
+        counter matches a full rescan, and every evictable leaf has a
+        live entry in the eviction heap.  Raises AssertionError."""
         with self._lock:
             counts = {}
             for table in self._tables.values():
@@ -452,6 +552,20 @@ class PagedKVCache:
                 "page both free and referenced"
             assert len(self._free) + len(counts) == self.num_pages, \
                 "pages leaked: free + referenced != pool"
+            for page, node in self._tree_pages.items():
+                assert node.page == page, \
+                    f"tree-page map drift: {page} -> node.page {node.page}"
+            evictable = sum(1 for p in self._tree_pages
+                            if self._ref.get(p) == 1)
+            assert evictable == self._evictable, \
+                (f"evictable counter drift: counted {evictable} vs "
+                 f"{self._evictable}")
+            for node in self._iter_nodes_locked():
+                if node.children or self._ref.get(node.page) != 1:
+                    continue
+                assert any(nd is node and lu == node.last_used
+                           for lu, _, nd in self._evict_heap), \
+                    f"evictable leaf (page {node.page}) missing from heap"
 
     # ---------------------------------------------------------- page table
     def page_table(self, seq_id, width=None):
@@ -503,6 +617,7 @@ class PagedKVCache:
             for node in self._iter_nodes_locked():
                 node.page = remap[node.page]
             self._ref = {remap[p]: c for p, c in self._ref.items()}
-            self._tree_pages = {remap[p] for p in self._tree_pages}
+            self._tree_pages = {remap[p]: nd
+                                for p, nd in self._tree_pages.items()}
             self._free = list(range(self.num_pages - 1, n_used - 1, -1))
             return moved

@@ -411,11 +411,15 @@ class FleetRouter:
         with self._lock:
             self._prefix_summaries.update(fresh)
 
-    def _expected_hit_tokens_locked(self, tokens, replica_id):
+    def _expected_hit_tokens_locked(self, tokens, replica_id,
+                                    hash_cache=None):
         """Expected prefix-cache hit length (tokens) of an admission
         carrying ``tokens`` on ``replica_id``, from its gossiped
         summary: hash the prompt's page-aligned prefixes client-side
         and take the deepest hash the replica's radix summary knows.
+        The hash chain depends only on the prompt and the page size —
+        ``hash_cache`` (page_size -> chain) lets the _admit loop hash a
+        queue head once and score every candidate replica against it.
         Caller holds ``self._lock`` (summaries are shared state)."""
         summary = self._prefix_summaries.get(replica_id)
         if not summary or not summary.get("enabled", True):
@@ -424,8 +428,14 @@ class FleetRouter:
         if not entries:
             return 0
         page_size = int(summary.get("page_size") or 16)
+        if hash_cache is None:
+            hash_cache = {}
+        hashes = hash_cache.get(page_size)
+        if hashes is None:
+            hashes = hash_cache[page_size] = prefix_hashes(
+                tokens, page_size)
         best = 0
-        for i, h in enumerate(prefix_hashes(tokens, page_size)):
+        for i, h in enumerate(hashes):
             if h in entries:
                 best = (i + 1) * page_size
         return min(best, max(len(tokens) - 1, 0))
@@ -523,6 +533,7 @@ class FleetRouter:
             while self._pending:
                 head = self._pending[0]
                 admission_tokens = head.prompt + head.tokens_out
+                hash_cache = {}    # page_size -> prefix hash chain
                 cands = []
                 for rep in self.replicas:
                     if rep.replica_id in skip or \
@@ -535,7 +546,7 @@ class FleetRouter:
                         continue
                     drain = float(h.get("estimated_drain_s") or 0.0)
                     hit = (self._expected_hit_tokens_locked(
-                        admission_tokens, rep.replica_id)
+                        admission_tokens, rep.replica_id, hash_cache)
                         if self.cache_aware else 0)
                     cands.append(
                         (drain - hit * self.cache_hit_token_s,

@@ -39,19 +39,31 @@ jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 # milliseconds per test; deserializing them kills the whole run.
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-from jax._src import compilation_cache as _cc  # noqa: E402
+# best-effort: jax._src.compilation_cache.put_executable_and_time is a
+# PRIVATE symbol and the "jit_step"/"jit__step" module naming is a jit
+# convention — both can move under a jax upgrade.  If either is gone,
+# fall back to stock persistent caching (slower repeat runs, nothing
+# broken) instead of failing collection.
+try:
+    from jax._src import compilation_cache as _cc  # noqa: E402
 
-_orig_put = _cc.put_executable_and_time
+    _orig_put = _cc.put_executable_and_time
+except (ImportError, AttributeError):
+    _cc = None
 
+if _cc is not None:
 
-def _selective_put(cache_key, module_name, executable, backend,
-                   compile_time):
-    if module_name.startswith(("jit_step", "jit__step")):
-        _orig_put(cache_key, module_name, executable, backend,
-                  compile_time)
+    def _selective_put(*args, **kwargs):
+        module_name = kwargs.get(
+            "module_name", args[1] if len(args) > 1 else None)
+        if isinstance(module_name, str) and not module_name.startswith(
+                ("jit_step", "jit__step")):
+            return None   # eager primitive: never persist (see above)
+        # step program — or an unrecognized signature, where the stock
+        # behavior is the safe degradation
+        return _orig_put(*args, **kwargs)
 
-
-_cc.put_executable_and_time = _selective_put
+    _cc.put_executable_and_time = _selective_put
 # keep XLA:CPU AOT blobs out of the cache: reloading them trips a
 # machine-feature check (prefer-no-scatter/-gather) and spams stderr
 jax.config.update("jax_persistent_cache_enable_xla_caches", "none")

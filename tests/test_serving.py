@@ -937,6 +937,53 @@ class TestPrefixCache:
         assert "b" not in c.seq_ids()
         c.check_integrity()
 
+    def test_pressure_eviction_never_reclaims_the_matched_chain(self):
+        """Regression (review): allocation-pressure eviction used to
+        run BEFORE the matched chain's refcounts were bumped, so a
+        zero-ref matched page could be LRU-evicted and handed straight
+        back as a "fresh" page for the SAME sequence — one physical
+        page at two logical table positions (refcounts still
+        consistent, so check_integrity alone missed it) and prefill
+        writes corrupting what attention reads as the cached prefix.
+        The chain is pinned first now; when the pinned match starves
+        its own admission the match shrinks instead of corrupting."""
+        c = PagedKVCache(num_layers=1, num_heads=1, head_dim=2,
+                         num_pages=3, page_size=4, max_seq_len=16)
+        toks = list(range(12))                   # 3 full pages
+        assert c.allocate("a", 12)
+        c.insert_prefix("a", toks)
+        c.free("a")
+        assert c.num_free_pages == 3             # pool = zero-ref cache
+        # a 16-token prompt matching all 12 cached tokens needs 4
+        # pages: the pool can only admit it by giving back part of the
+        # match — never by evicting a page it is about to attach
+        m = c.allocate_prefixed("b", toks + [99, 98, 97, 96],
+                                chunk_tokens=8)
+        assert m == 4                            # shrunk hit, not a dup
+        table = c.page_table("b")[:3]
+        assert len(set(table)) == len(table)     # no page twice
+        c.check_integrity()
+
+    def test_cow_source_pinned_and_shrunk_under_pressure(self):
+        """Fully-cached prompt under total pool pressure: the COW
+        source is pinned through the fresh-page take (it used to be
+        evictable in the same window), and the admission falls back to
+        a shorter shared prefix rather than failing or self-copying."""
+        c = PagedKVCache(num_layers=1, num_heads=1, head_dim=2,
+                         num_pages=3, page_size=4, max_seq_len=16)
+        toks = list(range(12))
+        assert c.allocate("a", 12)
+        c.insert_prefix("a", toks)
+        c.free("a")
+        m = c.allocate_prefixed("cw", toks, chunk_tokens=4)
+        # full COW needs matched-chain + copy page = 4 pages on a
+        # 3-page pool: the deepest cached page is dropped, the first
+        # two stay shared, the tail prefills into the reclaimed page
+        assert m == 8
+        table = c.page_table("cw")[:3]
+        assert len(set(table)) == len(table)
+        c.check_integrity()
+
     # ---- engine parity --------------------------------------------------
     def test_cache_hit_greedy_parity_and_metrics(self, tiny_model):
         """A request sharing a finished request's prefix prefills only
