@@ -730,6 +730,121 @@ def bench_fleet(n_requests=30, rate_per_s=12.0, max_new=16, n_replicas=3,
     return out
 
 
+def bench_soak(horizon_s=60.0, base_rate_per_s=None, seed=0):
+    """Chaos soak — the long variant of the tier-1 compressed soak
+    (tests/test_soak.py), both backed by ``serving.run_soak``: a seeded
+    diurnal + bursty + shared-prefix trace replayed through an
+    **autoscaled** fleet while the chaos timeline fires hard kills,
+    admission stalls, control-loop stalls, and spawn io_errors.  The
+    invariants are the soak's exit criteria, asserted here exactly as
+    in CI:
+
+    - ``lost_requests`` MUST be 0 (exactly-once failover held across
+      every kill, stall, drain, and scale event);
+    - TTFT p99 bounded;
+    - at least one scale-up AND one scale-down recorded in ``/fleet``
+      (scraped over live HTTP from the run's own telemetry server);
+    - every chaos event visible as a ``soak::*`` flight record.
+    """
+    import dataclasses
+
+    import jax
+
+    from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
+    from paddle_tpu.serving import (ChaosEvent, Engine, TrafficGenerator,
+                                    run_soak)
+
+    on_tpu = jax.devices()[0].platform not in ("cpu", "gpu", "cuda")
+    name = "gpt2-small" if on_tpu else "tiny"
+    cfg = dataclasses.replace(GPT_CONFIGS[name], dtype="bfloat16")
+    params = gpt_init(cfg, jax.random.key(0))
+    if base_rate_per_s is None:
+        # the offered load must be inside the max-replicas fleet's
+        # capacity or the TTFT bound measures saturation, not recovery
+        # (CPU tiny goodput is ~8 req/s; bursts still 4x past it)
+        base_rate_per_s = 8.0 if on_tpu else 3.0
+
+    def factory():
+        return Engine(cfg, params, page_size=16,
+                      num_pages=1024 if on_tpu else 256,
+                      max_batch_size=4,
+                      chunk_len=min(32, cfg.max_seq_len),
+                      shed_queue_high=8, shed_queue_low=2)
+
+    # like bench_fleet: N engines jit N unified_step closures by
+    # design, so keep the fleet out of recompile telemetry
+    from paddle_tpu.observability.compile_watchdog import default_watchdog
+
+    traffic = TrafficGenerator(
+        base_rate_per_s=base_rate_per_s, diurnal_amplitude=0.8,
+        day_period_s=horizon_s / 2.0,
+        bursts=((horizon_s * 0.1, horizon_s * 0.15, 3.0),
+                (horizon_s * 0.6, horizon_s * 0.1, 4.0)),
+        n_cohorts=3, cohort_prefix_len=16, cohort_fraction=0.5,
+        prompt_len=(8, 40), max_new_tokens=(8, 16),
+        vocab_size=cfg.vocab_size, seed=seed)
+    chaos = [
+        ChaosEvent(t=horizon_s * 0.08, action="spawn_io_error"),
+        ChaosEvent(t=horizon_s * 0.2, action="stall_admit", stall_s=0.4),
+        ChaosEvent(t=horizon_s * 0.35, action="kill"),
+        ChaosEvent(t=horizon_s * 0.5, action="stall_poll", stall_s=0.3),
+        ChaosEvent(t=horizon_s * 0.65, action="kill"),
+        ChaosEvent(t=horizon_s * 0.8, action="stall_admit", stall_s=0.4),
+    ]
+    log(f"[soak] {name}: {horizon_s:.0f}s horizon, base "
+        f"{base_rate_per_s}/s diurnal+burst, {len(chaos)} chaos events")
+    wd = default_watchdog()
+    wd_prev, wd.enabled = wd.enabled, False
+    try:
+        report = run_soak(
+            factory, traffic, horizon_s=horizon_s,
+            initial_replicas=2, chaos=chaos,
+            scaler_kw=dict(min_replicas=1, max_replicas=4,
+                           up_pressure_s=1.0, down_pressure_s=0.15,
+                           up_pending_depth=6,
+                           scale_up_cooldown_s=horizon_s / 20.0,
+                           scale_down_cooldown_s=horizon_s / 12.0,
+                           spawn_max_retries=2),
+            deadline_s=horizon_s * 4.0, grace_s=horizon_s / 4.0,
+            ttft_bound_s=30.0)
+    finally:
+        wd.enabled = wd_prev
+
+    events = report["scale_events"]
+    assert report["lost_requests"] == 0, \
+        f"soak lost {report['lost_requests']} requests: zero-loss contract"
+    assert report["ttft_p99_ok"], \
+        f"soak TTFT p99 {report['ttft_p99_s']:.1f}s over the bound"
+    assert events.get("up", 0) >= 1 and events.get("down", 0) >= 1, \
+        f"soak must scale both ways, got {events}"
+    assert report["scraped"]["fleet"]["autoscaler"]["scale_events"], \
+        "scale events missing from the scraped /fleet payload"
+    out = {
+        "model": name,
+        "horizon_s": horizon_s,
+        "wall_s": report["wall_s"],
+        "timed_out": report["timed_out"],
+        "requests": report["requests_submitted"],
+        "finished": report["requests_finished"],
+        "lost_requests": report["lost_requests"],
+        "ttft_p50_s": report["ttft_p50_s"],
+        "ttft_p99_s": report["ttft_p99_s"],
+        "ttft_p99_ok": report.get("ttft_p99_ok"),
+        "redispatched": report["redispatched"],
+        "scale_events": events,
+        "spawn_failures": report["spawn_failures"],
+        "chaos": report["chaos"],
+        "injector_fired": report["injector_fired"],
+        "traffic": report["traffic"],
+    }
+    log(f"[soak] {out['finished']}/{out['requests']} finished, lost "
+        f"{out['lost_requests']}, scale up×{events.get('up', 0)} "
+        f"down×{events.get('down', 0)}, TTFT p99 "
+        f"{(out['ttft_p99_s'] or 0) * 1e3:.0f}ms, "
+        f"{len(out['chaos'])} chaos events fired")
+    return out
+
+
 def bench_ps(rows=100_000, dim=64, batch=4096):
     """Sparse parameter-server scale check: a 100k-row table pulled and
     pushed through the PSClient in loader-sized batches, reporting
@@ -1385,7 +1500,7 @@ def main():
     ap.add_argument("--no-serving", action="store_true")
     ap.add_argument("--section",
                     choices=["gpt", "rung", "flash", "resnet", "ps",
-                             "serving", "fleet", "resilience",
+                             "serving", "fleet", "soak", "resilience",
                              "distributed", "integrity", "lint",
                              "multichip"],
                     help="internal: run ONE section in-process, print "
@@ -1437,6 +1552,9 @@ def main():
         return
     if args.section == "fleet":
         print(json.dumps(_section_telemetry(bench_fleet())))
+        return
+    if args.section == "soak":
+        print(json.dumps(_section_telemetry(bench_soak())))
         return
     if args.section == "resilience":
         print(json.dumps(_section_telemetry(bench_resilience())))
@@ -1507,6 +1625,8 @@ def main():
                                         timeout_s=1500, tag="serving")
         extra["fleet"] = _run_section(["--section", "fleet"],
                                       timeout_s=1500, tag="fleet")
+        extra["soak"] = _run_section(["--section", "soak"],
+                                     timeout_s=1500, tag="soak")
     extra["resilience"] = _run_section(["--section", "resilience"],
                                        timeout_s=600, tag="resilience")
     extra["distributed"] = _run_section(["--section", "distributed"],

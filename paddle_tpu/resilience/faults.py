@@ -45,6 +45,17 @@ once by :func:`install_from_env`.
 Every fired fault increments ``faults_injected_total{site=,kind=}`` in
 the default metrics registry, so a fault-injection run's telemetry
 shows exactly what was injected where.
+
+Control-plane sites: the serving stack's data-plane sites
+(``serving.admit``, ``serving.step``) are joined by the autoscaler's
+control loop — ``autoscaler.poll`` fires at the top of every
+:meth:`~paddle_tpu.serving.Autoscaler.tick` (a ``stall`` there is the
+control loop hiccuping: scaling is delayed, never wrong) and
+``autoscaler.scale_up`` fires before every spawn attempt (an
+``io_error`` is a spawn that died mid-flight, retried with bounded
+jittered backoff — the PR 6 supervisor discipline).  The chaos soak
+harness (``bench.py --section soak``) exercises both alongside hard
+replica kills as its standing kill matrix.
 """
 from __future__ import annotations
 

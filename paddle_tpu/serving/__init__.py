@@ -127,6 +127,55 @@ robustness unit.  Semantics it guarantees:
   the telemetry server) is 503 only when NO replica can admit: all
   breakers open or draining.  One shedding replica is soft
   backpressure, not an outage.
+
+Autoscaler contract (:mod:`autoscaler` — README "Elastic fleet"): an
+:class:`Autoscaler` attached to a router sizes the fleet from live
+signals.  Semantics it guarantees:
+
+- **signals** — each tick polls, on an injectable clock: every healthy
+  replica's ``estimated_drain_s`` and queue depth, the router's
+  pending depth, the shed/RETRY_AFTER delta since the last poll, and
+  the goodput ratio (finished ÷ dispatched, telemetry).  They fold
+  into one *pressure* figure: mean drain seconds per **ready** replica
+  plus a pending-depth term.
+- **warming is not capacity** — a replica whose decode EWMA has no
+  real sample (``health()['decode_rate_tok_s'] is None``) still
+  advertises ``drain_floor_s`` and is excluded from the ready count.
+  ``Engine.warmup()`` preserves this: it compiles the unified step via
+  one tiny request, then resets the EWMA, so a freshly scaled-up
+  replica enters rotation warm-compiled but still on the cold-start
+  floor until its first real decode step.
+- **hysteresis + per-direction cooldowns** — up only when pressure is
+  *strictly* above ``up_pressure_s`` (or pending strictly above
+  ``up_pending_depth``, or any shed since the last poll); down only
+  when pressure is *strictly* below ``down_pressure_s`` with zero
+  pending/queued/shed and nothing draining.  Load exactly on a band
+  boundary produces zero events, and each direction freezes for its
+  own cooldown after acting — no flapping.
+- **scale-up = supervised spawn** — revive the cheapest DEAD
+  restartable replica, else append through the engine factory
+  (``router.add_replica``); either way ``warmup()`` runs before
+  rotation entry, and spawn attempts retry with jittered backoff out
+  of a bounded budget (the supervisor discipline; the
+  ``autoscaler.scale_up`` fault site injects the OSError this path
+  must survive, ``autoscaler.poll`` the control-loop stall).
+- **scale-down = cache-warmth-aware drain** — victim is the *coldest*
+  healthy replica by gossiped radix summary (sum of cached prefix
+  token depths = the prefill FLOPs its cache is worth; ties: fewest
+  in-flight, then youngest), drained gracefully with
+  ``router.drain(rid, restart=False)`` — stragglers re-dispatch
+  exactly once, zero loss holds through every scale event.
+- **observability** — ``autoscaler_scale_events_total{direction,
+  reason}`` / ``autoscaler_target_replicas`` / ``autoscaler::scale``
+  spans, and an ``autoscaler`` block folded into ``/fleet``.
+
+Soak exit criteria (:mod:`soak`, ``bench.py --section soak`` and the
+compressed tier-1 variant): replaying a seeded diurnal/bursty trace
+(:mod:`traffic`) through the autoscaled fleet while the chaos timeline
+fires hard kills, admission stalls, poll stalls, and spawn I/O errors
+must end with ``lost_requests == 0``, bounded TTFT p99, at least one
+scale-up AND one scale-down recorded in ``/fleet``, and every chaos
+event visible as a ``soak::*`` record in ``/flight``.
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401
 from .kv_cache import PagedKVCache, prefix_hashes  # noqa: F401
@@ -135,6 +184,7 @@ from .prefix_gossip import (  # noqa: F401
     collect_prefix_summaries,
 )
 from .metrics import (  # noqa: F401
+    AutoscalerMetrics,
     Counter,
     Gauge,
     Histogram,
@@ -148,3 +198,7 @@ from .router import (  # noqa: F401
     Replica,
     ReplicaState,
 )
+from .autoscaler import Autoscaler  # noqa: F401
+from .traffic import Arrival, TrafficGenerator  # noqa: F401
+from .replica import ReplicaServer  # noqa: F401
+from .soak import ChaosEvent, run_soak  # noqa: F401

@@ -856,3 +856,20 @@ class Engine:
         while self.has_work():
             self.step()
         return [r.output for r in reqs]
+
+    def warmup(self, prompt_len=4, max_new_tokens=2):
+        """Pre-rotation warmup: run one tiny request end-to-end so the
+        unified step compiles now, not on the first real request —
+        then RESET the decode-rate EWMA.  The warmup steps time jit
+        compilation, not steady-state decode, so their rate samples
+        are garbage; discarding them keeps ``drain_floor_s``
+        advertised (``estimated_drain_s`` stays on the cold-start
+        floor, ``health()['decode_rate_tok_s']`` stays None) until the
+        first *real* decode step measures the true rate.  The
+        autoscaler reads that None as "warming, not capacity yet"."""
+        n = max(1, min(int(prompt_len), self.cfg.max_seq_len // 2))
+        prompt = list(range(1, n + 1))
+        self.generate([prompt],
+                      SamplingParams(max_new_tokens=int(max_new_tokens)))
+        self._decode_rate_ewma = None
+        return self

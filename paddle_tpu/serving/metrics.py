@@ -36,7 +36,7 @@ from ..observability.metrics import (  # noqa: F401  (re-export compat)
 )
 
 __all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics",
-           "RouterMetrics"]
+           "RouterMetrics", "AutoscalerMetrics"]
 
 
 class ServingMetrics:
@@ -195,6 +195,10 @@ class RouterMetrics:
             "router_redispatched_requests_total",
             help="in-flight requests re-enqueued off a failed or "
                  "drained replica (each exactly once per event)"))
+        self.finished = add(Counter(
+            "router_requests_finished_total",
+            help="fleet requests harvested to FINISHED — the goodput "
+                 "numerator the autoscaler reads"))
         self.backpressure_retries = add(Counter(
             "router_backpressure_retries_total", labelnames=("replica",),
             help="dispatches deferred because the replica answered "
@@ -242,6 +246,7 @@ class RouterMetrics:
             "dispatches": self._family(self.dispatches),
             "failovers": self._family(self.failovers),
             "redispatched": self.redispatched.value,
+            "finished": self.finished.value,
             "backpressure_retries": self._family(self.backpressure_retries),
             "cache_aware_dispatches": self.cache_aware_dispatches.value,
             "drains": self._family(self.drains),
@@ -252,4 +257,49 @@ class RouterMetrics:
             "fleet_healthy": self.fleet_healthy.value,
             "pending_depth": self.pending_depth.value,
             "ttft_s": self.ttft.summary(),
+        }
+
+
+class AutoscalerMetrics:
+    """Autoscaler metric facade (``autoscaler_*`` series).  One
+    instance per :class:`~paddle_tpu.serving.Autoscaler`; registers
+    into the default registry with replace semantics unless an
+    explicit registry is passed (the test-isolation idiom)."""
+
+    def __init__(self, registry=None):
+        self.registry = default_registry() if registry is None else registry
+        reg = self.registry
+
+        def add(metric):
+            return reg.register(metric, replace=True)
+
+        self.scale_events = add(Counter(
+            "autoscaler_scale_events_total",
+            labelnames=("direction", "reason"),
+            help="scale decisions acted on — direction up|down, reason "
+                 "pressure|pending|shed|no_capacity|idle"))
+        self.spawn_failures = add(Counter(
+            "autoscaler_spawn_failures_total",
+            help="scale-up attempts that exhausted the bounded spawn "
+                 "retry budget (backoff included) without a replica"))
+        self.target_replicas = add(Gauge(
+            "autoscaler_target_replicas",
+            help="in-rotation replica count the last decision aimed "
+                 "for (healthy count when holding steady)"))
+        self.ready_replicas = add(Gauge(
+            "autoscaler_ready_replicas",
+            help="healthy replicas with a real decode-rate sample — "
+                 "warming replicas are excluded from capacity"))
+        self.pressure = add(Gauge(
+            "autoscaler_pressure_seconds",
+            help="fleet pressure signal: mean estimated drain seconds "
+                 "per ready replica plus the pending-depth term"))
+
+    def snapshot(self):
+        return {
+            "scale_events": RouterMetrics._family(self.scale_events),
+            "spawn_failures": self.spawn_failures.value,
+            "target_replicas": self.target_replicas.value,
+            "ready_replicas": self.ready_replicas.value,
+            "pressure_s": self.pressure.value,
         }

@@ -1,0 +1,130 @@
+"""Per-replica serve loop — the process a fleet replica lives in.
+
+PR 14's prefix gossip made the publisher machinery
+(:class:`~.prefix_gossip.PrefixSummaryPublisher`) available but left
+wiring it to callers: in-process fleets pull ``engine.prefix_summary()``
+directly, and a replica running as its own process had nothing driving
+its gossip.  :class:`ReplicaServer` is that missing piece — the
+canonical body of one replica process:
+
+- builds (or adopts) the engine,
+- owns exactly one :class:`~.prefix_gossip.PrefixSummaryPublisher`
+  when a TCPStore is given, started for precisely the serve loop's
+  lifetime (started in :meth:`serve`, stopped in its ``finally`` —
+  a crashed loop never leaves a publisher gossiping for a corpse),
+- drives ``engine.step()`` whenever the scheduler has work.
+
+With each replica process running a ``ReplicaServer`` and the router
+built with ``prefix_summary_source=lambda:
+collect_prefix_summaries(store, ids)``, the autoscaler's cache-warmth
+victim selection and the router's cache-aware placement both see
+cross-process warmth — the same scores the in-process fleet gets, now
+over the TCPStore plane.
+
+Wiring::
+
+    # each replica process
+    srv = ReplicaServer(lambda: Engine(cfg, params), replica_id=r,
+                        store=store, gossip_interval_s=1.0)
+    srv.serve(should_stop=shutdown_event.is_set)
+
+    # the router/autoscaler process
+    router = FleetRouter(..., prefix_summary_source=lambda:
+        collect_prefix_summaries(store, range(n_replicas)))
+"""
+from __future__ import annotations
+
+import time
+
+from .prefix_gossip import PrefixSummaryPublisher
+
+__all__ = ["ReplicaServer"]
+
+
+class ReplicaServer:
+    """One replica process's serve loop + its gossip publisher.
+
+    ``engine_or_factory`` is a live engine or a zero-arg factory
+    (``warmup=True`` runs :meth:`~.engine.Engine.warmup` on a
+    factory-built engine before serving — rotation entry is warm but
+    the decode EWMA stays unsampled).  ``store=None`` serves without
+    gossip (a single-process deployment); with a store, one
+    :class:`PrefixSummaryPublisher` publishes this replica's bounded
+    radix summary every ``gossip_interval_s`` while :meth:`serve`
+    runs.  ``idle_sleep_s`` is the poll interval when the scheduler
+    is empty."""
+
+    def __init__(self, engine_or_factory, replica_id, *, store=None,
+                 gossip_interval_s=1.0, gossip_max_entries=32,
+                 key_prefix="prefix", warmup=True, idle_sleep_s=0.001,
+                 clock=None):
+        if callable(engine_or_factory) and \
+                not hasattr(engine_or_factory, "step"):
+            self.engine = engine_or_factory()
+            if warmup:
+                self.engine.warmup()
+        else:
+            self.engine = engine_or_factory
+        self.replica_id = int(replica_id)
+        self.gossip_interval_s = float(gossip_interval_s)
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.steps = 0
+        self.publisher = None
+        if store is not None:
+            self.publisher = PrefixSummaryPublisher(
+                self.engine, self.replica_id, store,
+                key_prefix=key_prefix, max_entries=gossip_max_entries,
+                clock=clock)
+
+    def step(self):
+        """One scheduler step (inline-driving hook for tests)."""
+        self.steps += 1
+        return self.engine.step()
+
+    def serve(self, should_stop=None, max_steps=None):
+        """Drive the engine until ``should_stop()`` (or ``max_steps``
+        scheduler steps).  The gossip publisher thread runs for exactly
+        this loop's lifetime and pushes one final summary on the way
+        out, so a replica that drained-and-exited leaves its last
+        (usually empty) summary behind, not a stale warm one.  Returns
+        the number of steps served."""
+        if should_stop is None and max_steps is None:
+            raise ValueError("serve() needs should_stop and/or "
+                             "max_steps — an unbounded serve loop has "
+                             "no exit")
+        served = 0
+        if self.publisher is not None:
+            self.publisher.start(self.gossip_interval_s)
+        try:
+            # lint-ok: bounded-retries the loop's bound is the caller's
+            # should_stop()/max_steps, validated non-None above — a
+            # serve loop, not a retry loop
+            while True:
+                if should_stop is not None and should_stop():
+                    return served
+                if max_steps is not None and served >= max_steps:
+                    return served
+                if self.engine.has_work():
+                    self.step()
+                    served += 1
+                else:
+                    time.sleep(self.idle_sleep_s)
+        finally:
+            if self.publisher is not None:
+                self.publisher.stop()
+                try:
+                    self.publisher.publish()
+                except Exception:
+                    pass    # silent-ok: a flaky store at shutdown
+                    #         cannot matter — collectors treat the
+                    #         absent/stale key as a cold replica
+
+    def __enter__(self):
+        if self.publisher is not None:
+            self.publisher.start(self.gossip_interval_s)
+        return self
+
+    def __exit__(self, *exc):
+        if self.publisher is not None:
+            self.publisher.stop()
+        return False

@@ -254,6 +254,7 @@ class FleetRouter:
         # each replica published (direct engine pull, or a TCPStore
         # collector via prefix_summary_source)
         self._prefix_summaries = {}  # guarded-by: self._lock
+        self._autoscaler = None      # attach_autoscaler() wires one
         self._update_gauges()
 
     # ------------------------------------------------------------- lookup
@@ -326,6 +327,7 @@ class FleetRouter:
                 del table[freq.id]
                 self._finish(freq, FleetRequestState.FINISHED,
                              ereq.finish_reason)
+                self.metrics.finished.inc()
                 finished.append(freq)
             elif ereq.state == RequestState.EVICTED:
                 del table[freq.id]
@@ -648,6 +650,28 @@ class FleetRouter:
         rep.engine = _DeadEngine(replica_id)
         return rep
 
+    def add_replica(self, factory):
+        """Append fresh capacity mid-flight: build an engine through
+        ``factory`` (zero-arg callable), run the router warmup on it,
+        and enter it into rotation — the autoscaler's scale-up path.
+        The engine is built and warmed *before* the replica becomes
+        visible, so in-rotation replicas are never half-constructed."""
+        if not callable(factory):
+            raise ValueError("add_replica needs a zero-arg engine "
+                             "factory (restarts rebuild through it)")
+        eng = factory()
+        if self.warmup is not None:
+            self.warmup(eng)
+        with self._lock:
+            rid = max((r.replica_id for r in self.replicas),
+                      default=-1) + 1
+            rep = Replica(rid, eng, factory=factory)
+            self.replicas.append(rep)
+            self._assigned[rid] = {}
+            self.metrics.breaker_open.labels(replica=str(rid)).set(0)
+        self._update_gauges()
+        return rep
+
     # ---------------------------------------------------------------- step
     def step(self):
         """One fleet tick: advance every live replica one scheduler
@@ -713,6 +737,40 @@ class FleetRouter:
             return bool(self._pending) or \
                 any(self._assigned[rep.replica_id]
                     for rep in self.replicas)
+
+    def pending_depth(self):
+        """Requests waiting in the router queue (on no replica yet) —
+        one of the autoscaler's scale-up signals."""
+        with self._lock:
+            return len(self._pending)
+
+    def in_flight_counts(self):
+        """``{replica_id: requests currently assigned}`` — the
+        autoscaler's victim-selection tie-break input."""
+        with self._lock:
+            return {rep.replica_id: len(self._assigned[rep.replica_id])
+                    for rep in self.replicas}
+
+    def prefix_summaries(self):
+        """The freshest gossiped radix summary per replica (a copy) —
+        the autoscaler scores cache warmth from these."""
+        with self._lock:
+            return dict(self._prefix_summaries)
+
+    def refresh_prefix_summaries(self):
+        """Public refresh hook: re-pull every replica's radix summary
+        now (the autoscaler calls this before picking a drain victim,
+        so warmth scores reflect the current trees, not the last
+        dispatch tick's)."""
+        self._refresh_prefix_summaries()
+
+    def attach_autoscaler(self, scaler):
+        """Surface ``scaler.status()`` inside the ``/fleet`` payload.
+        The fold happens after the router lock is released (the
+        autoscaler takes its own lock *before* calling router methods,
+        so the two locks must never interleave the other way)."""
+        self._autoscaler = scaler
+        return scaler
 
     def generate(self, prompts, sampling=None):
         """Batch convenience mirroring ``Engine.generate``: submit all,
@@ -793,4 +851,14 @@ class FleetRouter:
             out["replicas"] = per
             out["cache_aware"] = self.cache_aware
             out["counters"] = self.metrics.snapshot()
-            return out
+        # autoscaler fold OUTSIDE the router lock: status() takes the
+        # autoscaler's lock, and ticks take that lock before calling
+        # into the router — folding under the router lock would
+        # interleave the two in opposite orders (deadlock hazard)
+        scaler = self._autoscaler
+        if scaler is not None:
+            try:
+                out["autoscaler"] = scaler.status()
+            except Exception as e:
+                out["autoscaler"] = {"error": repr(e)}
+        return out

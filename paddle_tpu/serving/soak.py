@@ -1,0 +1,255 @@
+"""Chaos soak harness — every resilience subsystem at once, for hours.
+
+Unit tests kill one replica at one site; the soak replays a *diurnal,
+bursty, shared-prefix* traffic trace (:mod:`.traffic`) through an
+**autoscaled** fleet (:mod:`.autoscaler` over :mod:`.router`) while a
+chaos timeline fires hard replica kills, admission stalls, control-
+loop stalls, and spawn I/O errors — the standing kill matrix.  One
+driver, :func:`run_soak`, backs both ``bench.py --section soak`` (the
+long variant) and the compressed tier-1 test, so the invariants are
+asserted by CI on every run and measured at scale by the bench:
+
+- ``lost_requests == 0`` — every submitted request reaches FINISHED
+  despite kills, stalls, drains, and scale events (the router's
+  exactly-once failover contract, held across the whole run);
+- **bounded TTFT p99** — recoveries cost latency, never starvation;
+- **elasticity both ways** — at least one scale-up (burst) and one
+  scale-down (trough) mid-run, recorded in ``/fleet``;
+- **visibility** — every chaos event lands a ``soak::<action>`` record
+  in the flight recorder (``/flight``) and every recovery shows in
+  ``/fleet`` (failovers, drains, restarts, autoscaler events), scraped
+  live over HTTP from the run's own telemetry server.
+
+Chaos is a timeline of :class:`ChaosEvent`\\ s, not a random spray:
+``kill`` hard-kills a healthy replica (``router.kill_replica`` — the
+SIGKILL emulation), ``stall_admit``/``stall_poll`` arm a one-shot
+``stall`` at the ``serving.admit`` / ``autoscaler.poll`` fault sites,
+``spawn_io_error`` arms a one-shot ``io_error`` at
+``autoscaler.scale_up`` (the next spawn attempt dies and is retried
+out of the bounded backoff budget).  Arming appends a
+``FaultSpec(site, kind, occurrence=hits+1)`` to the installed
+injector, so each event fires exactly once at the next hit — fully
+deterministic, fully audited (``report["injector_fired"]``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+
+from ..observability.flight import FlightRecorder
+from ..observability.exporter import start_telemetry_server
+from ..resilience.faults import FaultInjector, FaultSpec, install, uninstall
+from .autoscaler import Autoscaler
+from .engine import SamplingParams
+from .router import FleetRouter, FleetRequestState, ReplicaState
+
+__all__ = ["ChaosEvent", "run_soak"]
+
+_wall = time.perf_counter
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One scheduled chaos action: at trace-time ``t`` (seconds from
+    run start), do ``action`` — one of ``kill`` (hard replica death),
+    ``stall_admit`` / ``stall_poll`` (one-shot stall at the
+    ``serving.admit`` / ``autoscaler.poll`` site, ``stall_s`` long),
+    ``spawn_io_error`` (one-shot OSError at ``autoscaler.scale_up``).
+    ``fired``/``detail`` are filled in by the run."""
+
+    t: float
+    action: str
+    stall_s: float = 0.3
+    fired: bool = False
+    detail: object = None
+
+
+def _percentile(values, pct):
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(pct / 100.0 * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def _get_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fire_chaos(ev, router, inj, flight, log):
+    """Apply one due chaos event; every action leaves a flight-recorder
+    record so ``/flight`` shows the full chaos timeline."""
+    detail = None
+    if ev.action == "kill":
+        victim = next((rep for rep in router.replicas
+                       if rep.state == ReplicaState.HEALTHY), None)
+        if victim is None:
+            detail = "no healthy replica to kill"
+        else:
+            router.kill_replica(victim.replica_id)
+            detail = {"replica": victim.replica_id}
+    elif ev.action == "stall_admit":
+        inj.specs.append(FaultSpec(
+            "serving.admit", "stall",
+            occurrence=inj.hits("serving.admit") + 1,
+            stall_s=ev.stall_s))
+        detail = {"site": "serving.admit", "stall_s": ev.stall_s}
+    elif ev.action == "stall_poll":
+        inj.specs.append(FaultSpec(
+            "autoscaler.poll", "stall",
+            occurrence=inj.hits("autoscaler.poll") + 1,
+            stall_s=ev.stall_s))
+        detail = {"site": "autoscaler.poll", "stall_s": ev.stall_s}
+    elif ev.action == "spawn_io_error":
+        inj.specs.append(FaultSpec(
+            "autoscaler.scale_up", "io_error",
+            occurrence=inj.hits("autoscaler.scale_up") + 1))
+        detail = {"site": "autoscaler.scale_up"}
+    else:
+        raise ValueError(f"unknown chaos action {ev.action!r}")
+    ev.fired = True
+    ev.detail = detail
+    with flight.record(f"soak::{ev.action}", group="chaos"):
+        pass
+    log.append({"t": ev.t, "action": ev.action, "detail": detail})
+
+
+def run_soak(engine_factory, traffic, horizon_s, *,
+             initial_replicas=2, chaos=(), scaler_kw=None,
+             router_kw=None, registry=None, deadline_s=120.0,
+             grace_s=10.0, min_down_events=1, ttft_bound_s=None,
+             prewarm=True, telemetry=True, time_scale=1.0):
+    """Replay ``traffic.trace(horizon_s)`` through an autoscaled fleet
+    under the ``chaos`` timeline; return the invariant report.
+
+    ``engine_factory`` is the zero-arg factory both the initial fleet
+    and every scale-up build through.  ``scaler_kw``/``router_kw``
+    override :class:`Autoscaler`/:class:`FleetRouter` knobs.
+    ``deadline_s`` hard-bounds the drive loop (wall time);
+    ``grace_s`` bounds the post-trace settle loop that lets drains
+    finish and the trough scale-down land (``min_down_events``).
+    ``time_scale`` multiplies arrival timestamps (0.5 = replay the
+    trace twice as fast).  ``ttft_bound_s`` is echoed into the report
+    (``ttft_p99_ok``) when set.  With ``telemetry=True`` the run hosts
+    its own telemetry server and the report's ``scraped`` section is
+    fetched over live HTTP — the recoveries-visible-in-``/fleet``-and-
+    ``/flight`` check, not an in-process shortcut."""
+    scaler_kw = dict(scaler_kw or {})
+    router_kw = dict(router_kw or {})
+    arrivals = traffic.trace(horizon_s)
+    chaos = sorted((dataclasses.replace(ev) for ev in chaos),
+                   key=lambda ev: ev.t)
+    router_kw.setdefault("warmup", lambda eng: eng.warmup())
+    router = FleetRouter([engine_factory] * int(initial_replicas),
+                         registry=registry, **router_kw)
+    scaler = Autoscaler(router, engine_factory, registry=registry,
+                        **scaler_kw)
+    if prewarm:
+        # pay every initial replica's jit compile before t=0 (scale-ups
+        # still pay theirs mid-run — that's part of the scenario) while
+        # keeping the decode EWMA unsampled: replicas start on the
+        # drain floor exactly like freshly spawned ones
+        for rep in router.replicas:
+            rep.engine.warmup()
+    flight = FlightRecorder()
+    server = None
+    if telemetry:
+        server = start_telemetry_server(
+            port=0, router=router, registry=registry,
+            tracer=router.tracer, flight=flight)
+    inj = install(FaultInjector([], seed=traffic.seed))
+    chaos_log, reqs = [], []
+    timed_out = False
+    t0 = _wall()
+    try:
+        idx = 0
+        while True:
+            now = (_wall() - t0) / time_scale
+            for ev in chaos:
+                if not ev.fired and now >= ev.t:
+                    _fire_chaos(ev, router, inj, flight, chaos_log)
+            while idx < len(arrivals) and arrivals[idx].t <= now:
+                a = arrivals[idx]
+                idx += 1
+                reqs.append(router.submit(a.prompt, SamplingParams(
+                    max_new_tokens=a.max_new_tokens)))
+            router.step()
+            scaler.tick()
+            if _wall() - t0 >= deadline_s:
+                timed_out = True
+                break
+            if idx >= len(arrivals) and not router.has_work() and \
+                    all(ev.fired for ev in chaos):
+                break
+        # settle: the trace is over and the fleet is idle — keep the
+        # control loop beating so in-progress drains complete and the
+        # quiet-trough scale-down lands (its cooldown may still be
+        # running when the last request finishes)
+        g0 = _wall()
+        while _wall() - g0 < grace_s:
+            router.step()
+            scaler.tick()
+            downs = scaler.status()["scale_events"]["down"]
+            draining = any(rep.state == ReplicaState.DRAINING
+                           for rep in router.replicas)
+            if downs >= min_down_events and not draining and \
+                    not router.has_work():
+                break
+            time.sleep(0.002)
+    finally:
+        uninstall()
+    # ---- invariants -----------------------------------------------------
+    ttfts = [r.t_first_token - r.t_submit for r in reqs
+             if r.t_first_token is not None]
+    finished = sum(1 for r in reqs
+                   if r.state == FleetRequestState.FINISHED)
+    fleet = router.fleet_status()
+    lost = (len(reqs) - finished) + int(fleet["counters"]["lost"])
+    p99 = _percentile(ttfts, 99)
+    report = {
+        "wall_s": _wall() - t0,
+        "horizon_s": horizon_s,
+        "timed_out": timed_out,
+        "requests_submitted": len(reqs),
+        "requests_finished": finished,
+        "lost_requests": lost,
+        "ttft_p50_s": _percentile(ttfts, 50),
+        "ttft_p99_s": p99,
+        "redispatched": fleet["counters"]["redispatched"],
+        "scale_events": fleet.get("autoscaler", {}).get(
+            "scale_events", {}),
+        "spawn_failures": fleet.get("autoscaler", {}).get(
+            "spawn_failures", 0),
+        "chaos": chaos_log,
+        "injector_fired": [{"site": s, "kind": k, "occurrence": o}
+                           for s, k, o in inj.fired],
+        "traffic": traffic.summary(horizon_s),
+        "fleet": fleet,
+        "flight": flight.summary(),
+    }
+    if ttft_bound_s is not None:
+        report["ttft_bound_s"] = float(ttft_bound_s)
+        report["ttft_p99_ok"] = (p99 is not None
+                                 and p99 <= float(ttft_bound_s))
+    if server is not None:
+        try:
+            scraped = {"url": server.url,
+                       "fleet": _get_json(server.url + "/fleet"),
+                       "flight": _get_json(server.url + "/flight")}
+            try:
+                scraped["healthz"] = _get_json(server.url + "/healthz")
+                scraped["healthz_ok"] = True
+            except urllib.error.HTTPError as e:
+                # /healthz answers 503 when no replica can admit — a
+                # fleet scaled to zero at the end of the settle is a
+                # report field, not a crash
+                scraped["healthz_ok"] = False
+                scraped["healthz_status"] = e.code
+            report["scraped"] = scraped
+        finally:
+            server.stop()
+    return report

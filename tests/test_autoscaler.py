@@ -1,0 +1,428 @@
+"""Elastic-fleet autoscaler unit matrix.
+
+Stub engines + a manual clock make every decision path deterministic:
+hysteresis-band edges (load exactly on a boundary → zero events),
+burst → scale-up → quiet → cooldown-delayed scale-down, warming
+replicas excluded from capacity, cache-warmth-aware victim selection
+(in-process and over the gossip/store path), the bounded spawn-retry
+budget at the ``autoscaler.scale_up`` fault site, and dead-fleet
+revival.  One real-engine test pins the ``Engine.warmup()`` EWMA-reset
+contract the warming logic depends on (the drain-floor regression).
+"""
+import dataclasses
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.resilience import FaultSpec, injected_faults
+from paddle_tpu.serving import (Autoscaler, Engine, FleetRouter,
+                                PrefixSummaryPublisher, ReplicaServer,
+                                ReplicaState, RequestState,
+                                SamplingParams,
+                                collect_prefix_summaries)
+
+
+class _ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class _StubReq:
+    def __init__(self, prompt, sampling):
+        self.prompt = list(prompt)
+        self.sampling = sampling
+        self.state = RequestState.QUEUED
+        self.tokens = list(prompt)
+        self.finish_reason = None
+        self.retry_after_s = None
+
+    @property
+    def output(self):
+        return self.tokens[len(self.prompt):]
+
+
+class _StubEngine:
+    """Engine-shaped stub with hand-set router signals: ``drain`` is
+    the advertised estimate, ``rate=None`` means warming (no decode
+    EWMA sample yet), ``summary`` is the gossiped radix payload."""
+
+    def __init__(self, rate=120.0, drain=0.0, summary=None):
+        self.rate = rate
+        self.drain = drain
+        self.summary = summary if summary is not None else {
+            "page_size": 8, "enabled": True, "entries": {}, "stats": {}}
+        self.reqs = []
+        self.warmed = 0
+
+    def health(self):
+        return {"healthy": True, "queue_depth": 0,
+                "running": len(self.reqs), "page_occupancy": 0.0,
+                "estimated_drain_s": self.drain,
+                "decode_rate_tok_s": self.rate,
+                "prefix_cache": {"enabled": True}}
+
+    def add_request(self, prompt, sampling):
+        req = _StubReq(prompt, sampling)
+        self.reqs.append(req)
+        return req
+
+    def has_work(self):
+        return bool(self.reqs)
+
+    def step(self):
+        for req in self.reqs:
+            req.tokens.append(1)
+            if len(req.output) >= req.sampling.max_new_tokens:
+                req.state = RequestState.FINISHED
+                req.finish_reason = "length"
+        self.reqs = [r for r in self.reqs
+                     if r.state != RequestState.FINISHED]
+
+    def evacuate(self):
+        for req in self.reqs:
+            req.state = RequestState.EVACUATED
+        self.reqs = []
+
+    def prefix_summary(self, max_entries=32):
+        return self.summary
+
+    def warmup(self):
+        self.warmed += 1
+        return self
+
+
+def _stub_factory(**kw):
+    return lambda: _StubEngine(**kw)
+
+
+def _fleet(engines, clock, *, factory=None, scaler_kw=None, **router_kw):
+    registry = router_kw.pop("registry", None) or MetricsRegistry()
+    router = FleetRouter(engines, clock=clock, registry=registry,
+                         **router_kw)
+    kw = dict(min_replicas=1, max_replicas=4, up_pressure_s=2.0,
+              down_pressure_s=0.25, up_pending_depth=6,
+              scale_up_cooldown_s=5.0, scale_down_cooldown_s=10.0,
+              spawn_backoff_base_s=0.001, spawn_backoff_cap_s=0.002)
+    kw.update(scaler_kw or {})
+    scaler = Autoscaler(router, factory or _stub_factory(),
+                        clock=clock, registry=registry, **kw)
+    return router, scaler
+
+
+def _events(scaler):
+    return scaler.status()["scale_events"]
+
+
+# ----------------------------------------------------- hysteresis edges
+
+
+class TestHysteresis:
+    def test_boundary_oscillation_zero_events(self):
+        """Load oscillating EXACTLY between the two band edges must
+        produce zero scale events: both comparisons are strict."""
+        clock = _ManualClock()
+        stubs = [_StubEngine(drain=0.0), _StubEngine(drain=0.0)]
+        router, scaler = _fleet(stubs, clock)
+        for i in range(40):
+            drain = (scaler.up_pressure_s if i % 2 == 0
+                     else scaler.down_pressure_s)
+            for stub in stubs:
+                stub.drain = drain
+            clock.advance(30.0)       # every cooldown long expired
+            assert scaler.tick() is None
+        assert _events(scaler) == {"up": 0, "down": 0}
+        assert len(router.replicas) == 2
+        snap = scaler.metrics.snapshot()
+        assert snap["scale_events"] == {}
+        # the band edges themselves were really exercised
+        assert scaler.status()["last_signals"]["pressure_s"] in (
+            scaler.up_pressure_s, scaler.down_pressure_s)
+
+    def test_above_band_scales_up_below_scales_down(self):
+        clock = _ManualClock()
+        stub = _StubEngine(drain=0.0)
+        router, scaler = _fleet([stub], clock)
+        stub.drain = scaler.up_pressure_s + 0.01
+        assert scaler.tick() == ("up", "pressure")
+        assert len(router.replicas) == 2
+        stub.drain = scaler.down_pressure_s - 0.01
+        # the new replica is warming (factory stub has no EWMA state
+        # here: give it one so it counts as ready capacity)
+        router.replicas[1].engine.rate = 100.0
+        clock.advance(scaler.scale_down_cooldown_s + 0.1)
+        assert scaler.tick() == ("down", "idle")
+
+    def test_burst_up_quiet_then_cooldown_delayed_down(self):
+        """Burst → immediate up; quiet → the down waits out the
+        cooldown measured from the UP event (an up is never undone
+        in the same breath), then fires."""
+        clock = _ManualClock()
+        stub = _StubEngine(drain=0.0)
+        router, scaler = _fleet(
+            [stub], clock,
+            factory=_stub_factory(rate=100.0),
+            scaler_kw={"scale_down_cooldown_s": 10.0})
+        stub.drain = 5.0                       # burst
+        assert scaler.tick() == ("up", "pressure")
+        up_t = clock.t
+        stub.drain = 0.0                       # quiet again
+        for _ in range(9):                     # 9 s: inside the window
+            clock.advance(1.0)
+            assert scaler.tick() is None
+        assert _events(scaler) == {"up": 1, "down": 0}
+        clock.advance(1.5)                     # past the window
+        assert scaler.tick() == ("down", "idle")
+        assert clock.t - up_t >= scaler.scale_down_cooldown_s
+        assert _events(scaler) == {"up": 1, "down": 1}
+        # drained victim left rotation without a restart
+        states = [rep.state for rep in router.replicas]
+        assert states.count(ReplicaState.HEALTHY) == 1
+
+
+# ------------------------------------------------- warming ≠ capacity
+
+
+class TestWarmingCapacity:
+    def test_warming_replica_excluded_from_pressure_and_ready(self):
+        clock = _ManualClock()
+        busy = _StubEngine(drain=3.0)
+        router, scaler = _fleet(
+            [busy], clock, factory=_stub_factory(rate=None, drain=0.5),
+            scaler_kw={"max_replicas": 2})
+        assert scaler.tick() == ("up", "pressure")
+        clock.advance(1.0)
+        scaler.tick()
+        sig = scaler.status()["last_signals"]
+        # the fresh replica advertises its 0.5 s drain floor but has
+        # no decode sample: it is warming, not capacity — pressure
+        # stays the ready replica's full 3.0 s, not (3.0 + 0.5) / 2
+        assert sig["healthy"] == 2
+        assert sig["ready"] == 1
+        assert sig["warming"] == [1]
+        assert sig["pressure_s"] == pytest.approx(3.0)
+        # first real decode sample → same replica now counts
+        router.replicas[1].engine.rate = 80.0
+        clock.advance(1.0)
+        scaler.tick()
+        sig = scaler.status()["last_signals"]
+        assert sig["ready"] == 2 and sig["warming"] == []
+        assert sig["pressure_s"] == pytest.approx((3.0 + 0.5) / 2)
+
+    def test_spawned_engine_gets_router_warmup(self):
+        clock = _ManualClock()
+        warmed = []
+        router, scaler = _fleet(
+            [_StubEngine(drain=5.0)], clock,
+            factory=_stub_factory(rate=None),
+            warmup=lambda eng: warmed.append(eng.warmup()))
+        assert scaler.tick() == ("up", "pressure")
+        # warmup ran on the spawned engine BEFORE rotation entry
+        assert len(warmed) == 1
+        assert router.replicas[1].engine is warmed[0]
+        assert warmed[0].warmed == 1
+
+
+class TestWarmupEwmaReset:
+    def test_drain_floor_survives_warmup(self):
+        """Regression (the satellite fix): ``Engine.warmup()`` compiles
+        the unified step via a real tiny request but must RESET the
+        decode EWMA — a freshly scaled-up replica keeps advertising
+        ``drain_floor_s`` (and ``decode_rate_tok_s: None``) until a
+        real decode step samples the true rate."""
+        cfg = dataclasses.replace(GPT_CONFIGS["tiny"], dtype="float32")
+        params = gpt_init(cfg, jax.random.key(0), dtype=jnp.float32)
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=8)
+        eng.warmup()
+        assert not eng.has_work()
+        assert eng._decode_rate_ewma is None
+        assert eng.health()["decode_rate_tok_s"] is None
+        assert eng.estimated_drain_s() >= eng.drain_floor_s
+        # the first real decode replaces the floor with measurement
+        eng.generate([[5, 6, 7]], SamplingParams(max_new_tokens=3))
+        assert eng._decode_rate_ewma is not None
+        assert eng.estimated_drain_s() == 0.0     # idle, measured
+
+
+# ------------------------------------------------ victim selection
+
+
+class TestVictimSelection:
+    def _summary(self, entries):
+        return {"page_size": 8, "enabled": True, "entries": entries,
+                "stats": {"cached_pages": len(entries)}}
+
+    def test_coldest_replica_drains_first(self):
+        clock = _ManualClock()
+        warm = _StubEngine(summary=self._summary({"a": 64, "b": 32}))
+        cold = _StubEngine(summary=self._summary({}))
+        tepid = _StubEngine(summary=self._summary({"c": 16}))
+        router, scaler = _fleet([warm, cold, tepid], clock,
+                                scaler_kw={"min_replicas": 1})
+        clock.advance(60.0)
+        assert scaler.tick() == ("down", "idle")
+        assert router.replicas[1].state != ReplicaState.HEALTHY
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        assert router.replicas[2].state == ReplicaState.HEALTHY
+        event = scaler.status()["events"][-1]
+        assert event["replica"] == 1
+        assert event["victim_warm_tokens"] == 0
+
+    def test_warmth_tie_breaks_to_youngest(self):
+        clock = _ManualClock()
+        stubs = [_StubEngine(summary=self._summary({})),
+                 _StubEngine(summary=self._summary({})),
+                 _StubEngine(summary=self._summary({}))]
+        router, scaler = _fleet(stubs, clock)
+        clock.advance(60.0)
+        assert scaler.tick() == ("down", "idle")
+        # all equally cold, nothing in flight → the youngest (most
+        # recently added capacity) goes first
+        assert router.replicas[2].state != ReplicaState.HEALTHY
+
+    def test_warmth_scores_over_store_gossip(self):
+        """Cross-process path: replicas publish radix summaries over
+        the store plane; the autoscaler's victim selection reads the
+        collected summaries, not in-process engine state."""
+
+        class _FakeStore:
+            def __init__(self):
+                self.kv = {}
+
+            def set(self, key, value):
+                self.kv[key] = value
+
+            def mget(self, keys):
+                return [self.kv.get(k) for k in keys]
+
+        store = _FakeStore()
+        clock = _ManualClock()
+        engines = [_StubEngine(summary=self._summary({"a": 64})),
+                   _StubEngine(summary=self._summary({})),
+                   _StubEngine(summary=self._summary({"b": 128}))]
+        for rid, eng in enumerate(engines):
+            PrefixSummaryPublisher(eng, rid, store).publish()
+        router, scaler = _fleet(
+            engines, clock,
+            prefix_summary_source=lambda: collect_prefix_summaries(
+                store, range(3)))
+        clock.advance(60.0)
+        assert scaler.tick() == ("down", "idle")
+        assert router.replicas[1].state != ReplicaState.HEALTHY
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        assert router.replicas[2].state == ReplicaState.HEALTHY
+
+    def test_replica_server_hosts_gossip_publisher(self):
+        """The per-replica serve loop owns its publisher: the store
+        key appears while serving and carries the engine's summary."""
+
+        class _FakeStore:
+            def __init__(self):
+                self.kv = {}
+
+            def set(self, key, value):
+                self.kv[key] = value
+
+            def mget(self, keys):
+                return [self.kv.get(k) for k in keys]
+
+        store = _FakeStore()
+        eng = _StubEngine(summary=self._summary({"x": 24}))
+        eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=2))
+        srv = ReplicaServer(eng, 7, store=store,
+                            gossip_interval_s=0.01)
+        served = srv.serve(should_stop=lambda: not eng.has_work())
+        assert served == 2 and not eng.has_work()
+        raw = store.kv.get("prefix/replica_7")
+        assert raw is not None
+        payload = json.loads(raw)
+        assert payload["replica"] == 7
+        assert payload["summary"]["entries"] == {"x": 24}
+        collected = collect_prefix_summaries(store, [7])
+        assert collected[7]["entries"] == {"x": 24}
+        assert srv.publisher.running is False
+
+
+# ---------------------------------------------- spawn discipline
+
+
+@pytest.mark.faultinject
+class TestSpawnDiscipline:
+    def test_spawn_io_error_retried_within_budget(self):
+        clock = _ManualClock()
+        stub = _StubEngine(drain=5.0)
+        router, scaler = _fleet([stub], clock,
+                                factory=_stub_factory(rate=100.0),
+                                scaler_kw={"spawn_max_retries": 2})
+        with injected_faults(FaultSpec("autoscaler.scale_up",
+                                       "io_error", occurrence=1)):
+            assert scaler.tick() == ("up", "pressure")
+        assert len(router.replicas) == 2
+        status = scaler.status()
+        assert status["spawn_failures"] == 0
+        assert status["scale_events"] == {"up": 1, "down": 0}
+
+    def test_spawn_budget_exhaustion_counted_not_raised(self):
+        clock = _ManualClock()
+        stub = _StubEngine(drain=5.0)
+        router, scaler = _fleet([stub], clock,
+                                scaler_kw={"spawn_max_retries": 1})
+        specs = [FaultSpec("autoscaler.scale_up", "io_error",
+                           occurrence=i) for i in (1, 2)]
+        with injected_faults(*specs):
+            assert scaler.tick() is None       # budget exhausted
+        assert len(router.replicas) == 1
+        status = scaler.status()
+        assert status["spawn_failures"] == 1
+        assert status["scale_events"] == {"up": 0, "down": 0}
+        assert scaler.metrics.snapshot()["spawn_failures"] == 1
+
+    def test_dead_fleet_revives_through_restart_first(self):
+        """Scale-up prefers reviving a DEAD restartable replica over
+        spawning fresh — and a fully dead fleet bypasses the up
+        cooldown (recovery, not flap)."""
+        clock = _ManualClock()
+        router, scaler = _fleet([_stub_factory(rate=50.0)], clock)
+        router.kill_replica(0)
+        router.step()                          # probe miss 1
+        router.step()                          # probe miss 2 → DEAD
+        assert router.replicas[0].state == ReplicaState.DEAD
+        clock.advance(0.1)
+        assert scaler.tick() == ("up", "no_capacity")
+        assert len(router.replicas) == 1       # revived, not appended
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+
+
+# ---------------------------------------------------- status surface
+
+
+class TestStatusSurface:
+    def test_fleet_status_folds_autoscaler_block(self):
+        clock = _ManualClock()
+        stub = _StubEngine(drain=5.0)
+        router, scaler = _fleet([stub], clock,
+                                factory=_stub_factory(rate=100.0))
+        scaler.tick()
+        status = router.fleet_status()
+        block = status["autoscaler"]
+        assert block["scale_events"] == {"up": 1, "down": 0}
+        assert block["target_replicas"] == 2
+        assert block["bands"]["up_pressure_s"] == scaler.up_pressure_s
+        assert block["cooldown_remaining_s"]["up"] > 0
+        assert block["events"][-1]["direction"] == "up"
+        assert block["events"][-1]["reason"] == "pressure"
+        # the autoscaler::scale span landed in the tracer
+        names = [t["name"] for t in scaler.tracer.traces()]
+        assert "autoscaler::scale" in names

@@ -1,0 +1,117 @@
+"""Diurnal traffic generator: determinism, rate shape, cohorts."""
+import math
+
+import pytest
+
+from paddle_tpu.serving import TrafficGenerator
+
+
+class TestDeterminism:
+    def test_same_seed_same_trace(self):
+        gen = TrafficGenerator(base_rate_per_s=15.0, seed=7)
+        a = gen.trace(20.0)
+        b = gen.trace(20.0)
+        assert len(a) == len(b) > 0
+        for x, y in zip(a, b):
+            assert x.t == y.t
+            assert x.prompt == y.prompt
+            assert x.max_new_tokens == y.max_new_tokens
+            assert x.cohort == y.cohort
+
+    def test_fresh_generator_reproduces(self):
+        a = TrafficGenerator(base_rate_per_s=15.0, seed=7).trace(20.0)
+        b = TrafficGenerator(base_rate_per_s=15.0, seed=7).trace(20.0)
+        assert [(x.t, tuple(x.prompt)) for x in a] == \
+               [(y.t, tuple(y.prompt)) for y in b]
+
+    def test_different_seed_different_trace(self):
+        a = TrafficGenerator(base_rate_per_s=15.0, seed=1).trace(20.0)
+        b = TrafficGenerator(base_rate_per_s=15.0, seed=2).trace(20.0)
+        assert [x.t for x in a] != [y.t for y in b]
+
+
+class TestRateShape:
+    def test_diurnal_curve_peaks_and_troughs(self):
+        gen = TrafficGenerator(base_rate_per_s=10.0,
+                               diurnal_amplitude=0.8, day_period_s=40.0,
+                               seed=0)
+        peak = gen.rate_at(10.0)      # sin peak at period/4
+        trough = gen.rate_at(30.0)    # sin trough at 3·period/4
+        assert peak == pytest.approx(18.0)
+        assert trough == pytest.approx(2.0)
+        assert gen.rate_at(0.0) == pytest.approx(10.0)
+        assert gen.peak_rate() >= peak
+
+    def test_burst_multiplier_windows(self):
+        # bursts are (start_s, duration_s, multiplier): [5, 7) here
+        gen = TrafficGenerator(base_rate_per_s=10.0,
+                               diurnal_amplitude=0.0,
+                               bursts=((5.0, 2.0, 3.0),), seed=0)
+        assert gen.rate_at(4.9) == pytest.approx(10.0)
+        assert gen.rate_at(6.0) == pytest.approx(30.0)
+        assert gen.rate_at(7.1) == pytest.approx(10.0)
+        assert gen.peak_rate() == pytest.approx(30.0)
+
+    def test_arrival_density_follows_rate(self):
+        gen = TrafficGenerator(base_rate_per_s=30.0,
+                               diurnal_amplitude=0.9, day_period_s=40.0,
+                               seed=3)
+        arrivals = gen.trace(40.0)
+        # high half-period (sin > 0) vs low half-period
+        high = sum(1 for a in arrivals if 0.0 <= a.t < 20.0)
+        low = sum(1 for a in arrivals if 20.0 <= a.t < 40.0)
+        assert high > 2 * low > 0
+        assert all(arrivals[i].t <= arrivals[i + 1].t
+                   for i in range(len(arrivals) - 1))
+
+    def test_amplitude_validation(self):
+        with pytest.raises(ValueError):
+            TrafficGenerator(diurnal_amplitude=1.5)
+        with pytest.raises(ValueError):
+            TrafficGenerator(prompt_len=(24, 8))
+
+
+class TestCohorts:
+    def test_cohort_arrivals_share_prefix(self):
+        gen = TrafficGenerator(base_rate_per_s=25.0, n_cohorts=2,
+                               cohort_prefix_len=12,
+                               cohort_fraction=1.0, seed=5)
+        arrivals = gen.trace(10.0)
+        assert arrivals
+        prefixes = {}
+        for a in arrivals:
+            assert a.cohort in (0, 1)
+            prefixes.setdefault(a.cohort, set()).add(
+                tuple(a.prompt[:12]))
+        # every arrival in a cohort carries that cohort's exact prefix
+        assert all(len(ps) == 1 for ps in prefixes.values())
+        assert len(set().union(*prefixes.values())) == len(prefixes)
+
+    def test_cohort_fraction_zero_means_unique_prompts(self):
+        gen = TrafficGenerator(base_rate_per_s=25.0,
+                               cohort_fraction=0.0, seed=5)
+        arrivals = gen.trace(10.0)
+        assert arrivals
+        assert all(a.cohort is None for a in arrivals)
+        assert len({tuple(a.prompt) for a in arrivals}) == len(arrivals)
+
+    def test_prompt_and_decode_bounds(self):
+        gen = TrafficGenerator(base_rate_per_s=25.0, prompt_len=(8, 24),
+                               max_new_tokens=(4, 8), vocab_size=512,
+                               seed=9)
+        arrivals = gen.trace(10.0)
+        assert arrivals
+        for a in arrivals:
+            assert 8 <= len(a.prompt) <= 24
+            assert 4 <= a.max_new_tokens <= 8
+            assert all(0 <= tok < 512 for tok in a.prompt)
+
+    def test_summary_shape(self):
+        gen = TrafficGenerator(base_rate_per_s=10.0, seed=0,
+                               bursts=((2.0, 4.0, 2.0),))
+        s = gen.summary(20.0)
+        assert s["base_rate_per_s"] == 10.0
+        assert s["seed"] == 0
+        assert s["rate_max"] <= gen.peak_rate()
+        assert 0.0 <= s["rate_min"] <= s["rate_mean"] <= s["rate_max"]
+        assert math.isfinite(s["rate_mean"])
