@@ -1001,6 +1001,74 @@ def bench_distributed(iters=4000, shape=(1024,), reps=5):
     return out
 
 
+def bench_tracing(iters=3000, reps=5):
+    """Distributed-tracing overhead on the request hot path: one full
+    request-shaped trace lifecycle (root + queued/dispatch/decode-class
+    child spans with attributes, all ended) per iteration, under the
+    three shipping tracer postures — **full** (tail retention at
+    ``sample_rate=1.0``), **sampled** (boring traces kept at 1%;
+    shed/evicted/failover/slow still always retained), **disabled**
+    (``Tracer(enabled=False)`` — the shared null span).  Medians over
+    ``reps`` windows, pure host benchmark — no TPU.
+
+    The documented bound is <1% of a 50 ms TTFT-class request (the
+    tiny-model service time ``--section serving`` measures) with full
+    tracing on — a tier-1 smoke test asserts
+    ``implied_request_overhead_ratio`` stays under ``bound_ratio``."""
+    from paddle_tpu.observability.tracing import TailRetention, Tracer
+
+    SPANS_PER_REQUEST = 4       # root + queued + dispatch + decode
+    REQUEST_SECONDS = 0.05      # 50 ms TTFT-class request (tiny model)
+
+    def lifecycle(tracer, now):
+        root = tracer.start_trace("request#bench", start_s=now,
+                                  attributes={"prompt_len": 32})
+        for name in ("queued", "router::dispatch", "decode"):
+            sp = tracer.start_span(name, root, start_s=now)
+            sp.set_attribute("outcome", "ok")
+            sp.end(now + 0.001)
+        root.end(now + 0.002)
+
+    def per_request(tracer, n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            lifecycle(tracer, float(i))
+        return (time.perf_counter() - t0) / n
+
+    n = max(100, iters // reps)
+    modes = {
+        "full": Tracer(clock=time.perf_counter, max_traces=256),
+        "sampled": Tracer(clock=time.perf_counter, max_traces=256,
+                          retention=TailRetention(sample_rate=0.01)),
+        "disabled": Tracer(clock=time.perf_counter, enabled=False),
+    }
+    per_req = {}
+    for mode, tracer in modes.items():
+        per_request(tracer, n)               # warmup
+        per_req[mode] = float(np.median(
+            [per_request(tracer, n) for _ in range(reps)]))
+    ratio = per_req["full"] / REQUEST_SECONDS
+    out = {
+        "iters_per_window": n, "windows": reps,
+        "per_request_full_us": per_req["full"] * 1e6,
+        "per_request_sampled_us": per_req["sampled"] * 1e6,
+        "per_request_disabled_us": per_req["disabled"] * 1e6,
+        "spans_per_request": SPANS_PER_REQUEST,
+        "request_seconds_model": REQUEST_SECONDS,
+        "implied_request_overhead_ratio": ratio,
+        "bound_ratio": 0.01,
+        # retention proof: sampled mode actually dropped boring traces
+        "ring_full": modes["full"].summary(),
+        "ring_sampled": modes["sampled"].summary(),
+    }
+    log(f"[tracing] per-request {per_req['full']*1e6:.1f}us full / "
+        f"{per_req['sampled']*1e6:.1f}us sampled / "
+        f"{per_req['disabled']*1e6:.1f}us disabled "
+        f"({SPANS_PER_REQUEST} spans), implied {ratio*100:.3f}% of a "
+        f"{REQUEST_SECONDS*1e3:.0f}ms request [bound 1%]")
+    return out
+
+
 def bench_integrity(steps=20, fp_reps=9, replay_reps=5, hidden=1024,
                     batch=128, fingerprint_every=25, replay_every=100):
     """Silent-corruption sentinel overhead: the per-call cost of a
@@ -1501,8 +1569,8 @@ def main():
     ap.add_argument("--section",
                     choices=["gpt", "rung", "flash", "resnet", "ps",
                              "serving", "fleet", "soak", "resilience",
-                             "distributed", "integrity", "lint",
-                             "multichip"],
+                             "distributed", "tracing", "integrity",
+                             "lint", "multichip"],
                     help="internal: run ONE section in-process, print "
                          "its JSON")
     ap.add_argument("--rung", type=int, default=0,
@@ -1561,6 +1629,9 @@ def main():
         return
     if args.section == "distributed":
         print(json.dumps(_section_telemetry(bench_distributed())))
+        return
+    if args.section == "tracing":
+        print(json.dumps(_section_telemetry(bench_tracing())))
         return
     if args.section == "integrity":
         print(json.dumps(_section_telemetry(bench_integrity())))
@@ -1631,6 +1702,8 @@ def main():
                                        timeout_s=600, tag="resilience")
     extra["distributed"] = _run_section(["--section", "distributed"],
                                         timeout_s=600, tag="distributed")
+    extra["tracing"] = _run_section(["--section", "tracing"],
+                                    timeout_s=300, tag="tracing")
     extra["integrity"] = _run_section(["--section", "integrity"],
                                       timeout_s=600, tag="integrity")
     extra["lint"] = _run_section(["--section", "lint"],

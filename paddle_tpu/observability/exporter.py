@@ -23,7 +23,13 @@ enforces that):
                 while training is anomalous, or during an active
                 cross-rank hang (load balancers and fleet supervisors
                 eject on status alone)
-  ``/traces``   recent completed traces from the Tracer (``?limit=N``)
+  ``/traces``   recent completed traces from the Tracer (``?limit=N``);
+                ``?fleet=1`` serves the merged fleet view instead —
+                per-replica rings joined by trace_id (the attached
+                router's ``collect_traces()`` or a configured
+                ``fleet_traces`` store-plane collector), so a
+                failed-over request reads as ONE trace — 404 when
+                neither source is attached
   ``/flight``   the distributed flight recorder: collective-ring
                 summary + newest records, in-flight collectives, and
                 the hang watchdog's last desync report / bundle paths
@@ -244,8 +250,17 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
             elif url.path == "/traces":
                 q = parse_qs(url.query)
                 limit = int(q["limit"][0]) if "limit" in q else None
-                self._send(200, json.dumps(
-                    {"traces": srv.tracer.traces(limit=limit)}))
+                if q.get("fleet", ["0"])[0] not in ("0", "", "false"):
+                    merged = srv.fleet_traces(limit=limit)
+                    if merged is None:
+                        self._send(404, json.dumps(
+                            {"error": "no fleet trace source attached"}))
+                    else:
+                        self._send(200, json.dumps(
+                            {"fleet": True, "traces": merged}))
+                else:
+                    self._send(200, json.dumps(
+                        {"traces": srv.tracer.traces(limit=limit)}))
             elif url.path == "/flight":
                 self._send(200, json.dumps(srv.flightz(), default=str))
             elif url.path == "/fleet":
@@ -281,7 +296,7 @@ class TelemetryServer(ThreadingHTTPServer):
 
     def __init__(self, addr, registry, tracer, engine, watchdog,
                  aggregator=None, flight=None, hang=None, router=None,
-                 integrity=None):
+                 integrity=None, fleet_traces=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
@@ -292,7 +307,24 @@ class TelemetryServer(ThreadingHTTPServer):
         self.hang = hang
         self.router = router
         self.integrity = integrity
+        self._fleet_traces = fleet_traces
         self._serve_thread = None
+
+    def fleet_traces(self, limit=None):
+        """The merged fleet trace view behind ``/traces?fleet=1``: the
+        configured ``fleet_traces`` callable (a store-plane
+        ``collect_fleet_traces`` closure) when one was given, else the
+        attached router's in-process :meth:`collect_traces`.  None when
+        neither source exists (the endpoint 404s)."""
+        source = self._fleet_traces
+        if source is None and self.router is not None:
+            source = getattr(self.router, "collect_traces", None)
+        if source is None:
+            return None
+        merged = source()
+        if limit is not None:
+            merged = merged[-int(limit):]
+        return merged
 
     # ---- payload builders ----------------------------------------------
     def varz(self):
@@ -417,7 +449,8 @@ class TelemetryServer(ThreadingHTTPServer):
 def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                            tracer=None, engine=None, watchdog=None,
                            aggregator=None, flight=None, hang=None,
-                           router=None, integrity=None):
+                           router=None, integrity=None,
+                           fleet_traces=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -440,9 +473,13 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     :class:`~paddle_tpu.resilience.integrity.IntegrityCallback`)
     serves ``/integrity`` and makes ``/healthz`` go 503 while a
     confirmed state divergence is unrepaired (without one the
-    ``integrity_divergence_active`` gauge is folded instead).  Never
-    called on import anywhere in the framework — telemetry is strictly
-    opt-in.
+    ``integrity_divergence_active`` gauge is folded instead).
+    ``fleet_traces`` (a zero-arg callable returning a merged trace
+    list, e.g. a ``collect_fleet_traces(store, ids)`` closure) backs
+    ``/traces?fleet=1``; without it the attached router's
+    ``collect_traces()`` is used, and with neither the fleet view
+    404s.  Never called on import anywhere in the framework —
+    telemetry is strictly opt-in.
     """
     if tracer is None:
         if engine is not None and getattr(engine, "tracer", None):
@@ -455,5 +492,5 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                           registry or default_registry(), tracer,
                           engine, watchdog, aggregator=aggregator,
                           flight=flight, hang=hang, router=router,
-                          integrity=integrity)
+                          integrity=integrity, fleet_traces=fleet_traces)
     return srv._start()

@@ -169,22 +169,46 @@ class Histogram(_Metric):
         self.sum = 0.0          # guarded-by: self._lock
         self._reservoir = reservoir
         self._samples = []      # guarded-by: self._lock
+        # bucket index -> (exemplar trace_id, observed value): the last
+        # exemplar-carrying observation per bucket, so the exposition
+        # links each latency band to a concrete retained trace
+        self._exemplars = {}    # guarded-by: self._lock
 
     def _make_child(self):
         start, factor, count, reservoir = self._bucket_args
         return type(self)(self.name, self.help, start=start, factor=factor,
                           count=count, reservoir=reservoir)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
+        """Record ``v``; ``exemplar`` (a trace_id string, or None) pins
+        this observation as the bucket's exemplar — the OpenMetrics
+        ``# {trace_id="..."} v`` annotation that lets a p99 spike be
+        followed to one retained trace."""
         self._check_scalar("observe")
         v = float(v)
         with self._lock:
-            self.counts[bisect.bisect_left(self.buckets, v)] += 1
+            idx = bisect.bisect_left(self.buckets, v)
+            self.counts[idx] += 1
             self.total += 1
             self.sum += v
             self._samples.append(v)
             if len(self._samples) > self._reservoir:
                 del self._samples[:len(self._samples) - self._reservoir]
+            if exemplar is not None:
+                self._exemplars[idx] = (str(exemplar), v)
+
+    def exemplars(self):
+        """{bucket_le: {"trace_id", "value"}} — the newest exemplar per
+        bucket (``le`` is the bucket's upper bound as a string,
+        ``"+Inf"`` for the overflow bucket)."""
+        with self._lock:
+            ex = dict(self._exemplars)
+        out = {}
+        for idx, (tid, v) in sorted(ex.items()):
+            le = (f"{self.buckets[idx]:g}" if idx < len(self.buckets)
+                  else "+Inf")
+            out[le] = {"trace_id": tid, "value": v}
+        return out
 
     @property
     def mean(self):
@@ -215,10 +239,16 @@ class Histogram(_Metric):
         with self._lock:
             s = sorted(self._samples)
             total, total_sum = self.total, self.sum
-        return {"count": total,
-                "mean": total_sum / total if total else None,
-                "p50": self._pct(s, 50), "p95": self._pct(s, 95),
-                "p99": self._pct(s, 99)}
+            n_ex = len(self._exemplars)
+        out = {"count": total,
+               "mean": total_sum / total if total else None,
+               "p50": self._pct(s, 50), "p95": self._pct(s, 95),
+               "p99": self._pct(s, 99)}
+        if n_ex:
+            # surfaced in /varz only when some observation carried one:
+            # exemplar-free histograms keep their exact old shape
+            out["exemplars"] = self.exemplars()
+        return out
 
     def snapshot_value(self):
         return self.summary()
@@ -367,14 +397,18 @@ class MetricsRegistry:
                     with child._lock:
                         counts = list(child.counts)
                         total, total_sum = child.total, child.sum
+                        exemplars = dict(child._exemplars)
                     cum = 0
-                    for ub, c in zip(child.buckets, counts):
+                    for i, (ub, c) in enumerate(zip(child.buckets, counts)):
                         cum += c
                         le = (labels + "," if labels else "") + \
                             f'le="{ub:g}"'
-                        lines.append(_prom_line(name + "_bucket", le, cum))
+                        lines.append(_prom_line(name + "_bucket", le, cum)
+                                     + _prom_exemplar(exemplars.get(i)))
                     le = (labels + "," if labels else "") + 'le="+Inf"'
-                    lines.append(_prom_line(name + "_bucket", le, total))
+                    lines.append(_prom_line(name + "_bucket", le, total)
+                                 + _prom_exemplar(
+                                     exemplars.get(len(child.buckets))))
                     lines.append(_prom_line(name + "_sum", labels,
                                             total_sum))
                     lines.append(_prom_line(name + "_count", labels, total))
@@ -390,6 +424,15 @@ def _prom_line(name, labels, value):
     if isinstance(value, float):
         return f"{name}{lbl} {value:.9g}"
     return f"{name}{lbl} {value}"
+
+
+def _prom_exemplar(ex):
+    """OpenMetrics exemplar suffix for a ``_bucket`` line (empty string
+    when the bucket never saw an exemplar-carrying observation)."""
+    if ex is None:
+        return ""
+    tid, v = ex
+    return f' # {{trace_id="{tid}"}} {v:.9g}'
 
 
 _DEFAULT = MetricsRegistry()

@@ -211,6 +211,9 @@ class Profiler:
 
             self._device_dir = tempfile.mkdtemp(prefix="pt_prof_")
             try:
+                # lint-ok: span-discipline jax.profiler.start_trace is
+                # the device profiler (returns None), closed by
+                # jax.profiler.stop_trace() in stop() — not a tracer span
                 jax.profiler.start_trace(self._device_dir)
             except Exception:
                 self._device_dir = None

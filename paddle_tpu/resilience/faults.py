@@ -44,7 +44,13 @@ once by :func:`install_from_env`.
 
 Every fired fault increments ``faults_injected_total{site=,kind=}`` in
 the default metrics registry, so a fault-injection run's telemetry
-shows exactly what was injected where.
+shows exactly what was injected where.  A fired fault also records a
+``{site, kind, occurrence, seed}`` event on the **active span** (the
+thread's ambient :func:`~paddle_tpu.observability.tracing.active_span`,
+or an explicit ``fault_point(..., span=...)``) — a chaos-soak trace
+shows *where* the fault landed inline, no cross-referencing the
+counter; and the tracer's tail-retention policy pins every
+fault-carrying trace in the ring.
 
 Control-plane sites: the serving stack's data-plane sites
 (``serving.admit``, ``serving.step``) are joined by the autoscaler's
@@ -118,6 +124,7 @@ class FaultInjector:
         import numpy as np
 
         self.specs = list(specs)
+        self.seed = int(seed)    # echoed into span fault events
         self._rng = np.random.default_rng(seed)
         self._hits = {}          # site -> total hits
         self._fired = []         # [(site, kind, occurrence)] audit log
@@ -131,16 +138,24 @@ class FaultInjector:
         return list(self._fired)
 
     # ------------------------------------------------------------- firing
-    def _record(self, site, kind, occ):
+    def _record(self, site, kind, occ, span=None):
         self._fired.append((site, kind, occ))
         # lazy import: faults must be importable before the jax-adjacent
         # observability stack (and from tools that never touch it)
         from ..observability.metrics import default_registry
+        from ..observability.tracing import active_span
 
         default_registry().counter(
             "faults_injected_total",
             help="faults fired by the resilience fault injector",
             labelnames=("site", "kind")).labels(site=site, kind=kind).inc()
+        target = span if span is not None else active_span()
+        if target is not None:
+            # the trace-side audit record: retention classifies any
+            # fault-carrying trace as always-keep
+            target.attributes.setdefault("faults", []).append(
+                {"site": site, "kind": kind, "occurrence": occ,
+                 "seed": self.seed})
 
     def _file_of(self, path):
         """The file a path-targeted fault mutates: the path itself, or
@@ -190,13 +205,13 @@ class FaultInjector:
             f.seek(bit // 8)
             f.write(bytes([b[0] ^ (1 << (bit % 8))]))
 
-    def on_fault_point(self, site, path=None, tree=None):
+    def on_fault_point(self, site, path=None, tree=None, span=None):
         occ = self._hits.get(site, 0) + 1
         self._hits[site] = occ
         for spec in self.specs:
             if spec.site != site or spec.occurrence != occ:
                 continue
-            self._record(site, spec.kind, occ)
+            self._record(site, spec.kind, occ, span=span)
             if spec.kind == "kill":
                 raise SimulatedCrash(site, occ)
             if spec.kind == "torn_write":
@@ -246,13 +261,15 @@ def injected_faults(*specs, seed=0):
         uninstall()
 
 
-def fault_point(site, path=None, tree=None):
+def fault_point(site, path=None, tree=None, span=None):
     """Declare a named fault site.  No-op unless an injector is
     installed AND a spec matches this site at the current hit count.
     ``tree`` (a mutable ``{name: array}`` dict) exposes live state to
-    the ``bitflip`` kind — the caller must write replaced leaves back."""
+    the ``bitflip`` kind — the caller must write replaced leaves back.
+    ``span`` pins the fired-fault event to a specific span instead of
+    the thread's ambient :func:`active_span`."""
     if _injector is not None:
-        _injector.on_fault_point(site, path=path, tree=tree)
+        _injector.on_fault_point(site, path=path, tree=tree, span=span)
 
 
 def install_from_env(var="PADDLE_TPU_FAULTS"):

@@ -169,6 +169,39 @@ signals.  Semantics it guarantees:
   reason}`` / ``autoscaler_target_replicas`` / ``autoscaler::scale``
   spans, and an ``autoscaler`` block folded into ``/fleet``.
 
+Distributed-tracing contract (paddle_tpu.observability.tracing +
+:mod:`router` — README "Distributed tracing"): every request carries
+ONE globally unique ``trace_id`` from router admission to terminal
+state, across processes and across failures.  Semantics it guarantees:
+
+- **globally unique ids** — trace/span ids are prefixed with a
+  per-process nonce (pid + random), so segments recorded by the
+  router, by each replica engine, and by a restarted process never
+  collide and can be merged by ``trace_id`` alone.
+- **cross-process propagation** — the router serialises a
+  ``TraceContext`` (trace_id + parent span_id) into every dispatch;
+  ``Engine.add_request(..., trace_context=...)`` continues the trace
+  as a child segment.  A failover re-dispatch reuses the ORIGINAL
+  request's context, so a hard-killed request reads as one trace with
+  both ``router::dispatch`` hops and the ``router::failover`` span on
+  it — never two half-traces.
+- **tail-based retention** — completed traces are kept by what
+  happened on them (error, fault-injection event, flagged span,
+  rejection/retry/eviction/failover, deadline, slow-tail), with a
+  seeded coin-flip for the boring rest; the ring evicts boring-first,
+  so a flood of healthy traffic cannot push out the one trace that
+  shed or failed over.  Fired fault injections
+  (:mod:`paddle_tpu.resilience.faults`) record (site, kind,
+  occurrence, seed) on the ambient span, making a retained trace
+  self-describing.
+- **fleet collection** — each replica publishes its retained ring
+  over the TCPStore plane (``TraceRingPublisher`` /
+  ``collect_fleet_traces``); ``router.collect_traces()`` and the
+  telemetry server's ``/traces?fleet=1`` merge segments by trace_id
+  into one fleet-wide view, chrome-trace exportable.  Histogram
+  exemplars (``serving_ttft_seconds`` et al.) link each latency
+  bucket to a retained exemplar trace in the OpenMetrics exposition.
+
 Soak exit criteria (:mod:`soak`, ``bench.py --section soak`` and the
 compressed tier-1 variant): replaying a seeded diurnal/bursty trace
 (:mod:`traffic`) through the autoscaled fleet while the chaos timeline

@@ -81,7 +81,7 @@ import threading
 import time
 from collections import deque
 
-from ..observability.tracing import Tracer, default_tracer
+from ..observability.tracing import Tracer, activate, default_tracer
 from ..resilience.faults import fault_point
 from ..resilience.retry import backoff_delays
 from .metrics import AutoscalerMetrics
@@ -357,10 +357,18 @@ class Autoscaler:
                      "reason": reason,
                      "pressure_s": round(sig["pressure_s"], 4),
                      "pending_depth": sig["pending_depth"]}
+            # the scale span opens BEFORE the action so a fault firing
+            # mid-spawn (autoscaler.scale_up) lands on it as the
+            # ambient active span
+            span = self.tracer.start_trace(
+                "autoscaler::scale", start_s=now, attributes=event)
             if direction == "up":
-                rep = self._spawn_locked()
-                if rep is None:
-                    return None          # spawn budget exhausted
+                with activate(span):
+                    rep = self._spawn_locked()
+                if rep is None:          # spawn budget exhausted
+                    span.set_attribute("outcome", "spawn_failed")
+                    span.end(self._clock())
+                    return None
                 self._last_up = now
                 self._up_events += 1
                 event["replica"] = rep.replica_id
@@ -368,6 +376,8 @@ class Autoscaler:
             else:
                 victim, warm_tokens = self._pick_victim_locked()
                 if victim is None:
+                    span.set_attribute("outcome", "no_victim")
+                    span.end(self._clock())
                     return None
                 self.router.drain(victim.replica_id, restart=False)
                 self._last_down = now
@@ -379,8 +389,7 @@ class Autoscaler:
             self.metrics.scale_events.labels(
                 direction=direction, reason=reason).inc()
             self.metrics.target_replicas.set(self._target)
-            span = self.tracer.start_trace(
-                "autoscaler::scale", start_s=now, attributes=event)
+            span.set_attributes(event)
             span.end(self._clock())
             return decision
 

@@ -313,11 +313,16 @@ class Engine:
                               name="serving::unified_step")
 
     # ------------------------------------------------------------- submit
-    def add_request(self, prompt, sampling: SamplingParams = None):
+    def add_request(self, prompt, sampling: SamplingParams = None, *,
+                    trace_context=None):
         """Queue a prompt (list of token ids).  Returns the Request;
         state is REJECTED immediately when it can never be served, and
         a shed request carries ``retry_after_s`` (the live drain
-        estimate) next to its RETRY_AFTER state."""
+        estimate) next to its RETRY_AFTER state.  ``trace_context`` (a
+        :class:`~..observability.tracing.TraceContext` or its dict form)
+        continues a caller's trace — the router hands its dispatch
+        span's context over, so the request's whole engine lifecycle
+        records under the fleet trace instead of a fresh local one."""
         # fault site: a stall here is an admission wedge (the RPC thread
         # of a real deployment hanging in submit); an io_error is the
         # transport refusing the request.  The fleet router detects both.
@@ -337,7 +342,8 @@ class Engine:
             f"request#{req.id}", start_s=req.t_submit,
             attributes={"request_id": req.id,
                         "prompt_len": len(req.prompt),
-                        "max_new_tokens": sampling.max_new_tokens})
+                        "max_new_tokens": sampling.max_new_tokens},
+            context=trace_context)
 
         # chunked prefill admits any prompt the model itself can hold —
         # there is deliberately NO prompt-length gate below max_seq_len
@@ -692,7 +698,11 @@ class Engine:
                 # time-to-first-SAMPLED-token: stamped when the last
                 # prompt chunk completes, not when prefill starts
                 req.t_first_token = t1
-                self.metrics.ttft.observe(t1 - req.t_submit)
+                # exemplar: this observation's trace — the /metrics
+                # p99 bucket then names a trace the ring retains
+                self.metrics.ttft.observe(
+                    t1 - req.t_submit,
+                    exemplar=getattr(req._span, "trace_id", None))
             if not mid_prefill:
                 self.metrics.decode_token.observe(dt / n_rows)
                 if req._span is not None:

@@ -8,7 +8,8 @@ its gossip.  :class:`ReplicaServer` is that missing piece — the
 canonical body of one replica process:
 
 - builds (or adopts) the engine,
-- owns exactly one :class:`~.prefix_gossip.PrefixSummaryPublisher`
+- owns exactly one :class:`~.prefix_gossip.PrefixSummaryPublisher` and
+  one :class:`~paddle_tpu.observability.trace_gossip.TraceRingPublisher`
   when a TCPStore is given, started for precisely the serve loop's
   lifetime (started in :meth:`serve`, stopped in its ``finally`` —
   a crashed loop never leaves a publisher gossiping for a corpse),
@@ -19,7 +20,11 @@ built with ``prefix_summary_source=lambda:
 collect_prefix_summaries(store, ids)``, the autoscaler's cache-warmth
 victim selection and the router's cache-aware placement both see
 cross-process warmth — the same scores the in-process fleet gets, now
-over the TCPStore plane.
+over the TCPStore plane.  The trace publisher is the distributed-
+tracing leg of the same plane: each replica's completed-trace ring
+(globally-unique, nonce-prefixed trace ids) lands under its own store
+key, and ``collect_fleet_traces(store, ids)`` merges them by trace_id
+into the one-trace-per-request fleet view.
 
 Wiring::
 
@@ -31,18 +36,20 @@ Wiring::
     # the router/autoscaler process
     router = FleetRouter(..., prefix_summary_source=lambda:
         collect_prefix_summaries(store, range(n_replicas)))
+    fleet_view = collect_fleet_traces(store, range(n_replicas))
 """
 from __future__ import annotations
 
 import time
 
+from ..observability.trace_gossip import TraceRingPublisher
 from .prefix_gossip import PrefixSummaryPublisher
 
 __all__ = ["ReplicaServer"]
 
 
 class ReplicaServer:
-    """One replica process's serve loop + its gossip publisher.
+    """One replica process's serve loop + its gossip publishers.
 
     ``engine_or_factory`` is a live engine or a zero-arg factory
     (``warmup=True`` runs :meth:`~.engine.Engine.warmup` on a
@@ -50,14 +57,17 @@ class ReplicaServer:
     the decode EWMA stays unsampled).  ``store=None`` serves without
     gossip (a single-process deployment); with a store, one
     :class:`PrefixSummaryPublisher` publishes this replica's bounded
-    radix summary every ``gossip_interval_s`` while :meth:`serve`
-    runs.  ``idle_sleep_s`` is the poll interval when the scheduler
-    is empty."""
+    radix summary and one :class:`TraceRingPublisher` its completed-
+    trace ring, both every ``gossip_interval_s`` while :meth:`serve`
+    runs (``trace_gossip=False`` opts the trace leg out;
+    ``trace_max_traces`` bounds its payload).  ``idle_sleep_s`` is the
+    poll interval when the scheduler is empty."""
 
     def __init__(self, engine_or_factory, replica_id, *, store=None,
                  gossip_interval_s=1.0, gossip_max_entries=32,
-                 key_prefix="prefix", warmup=True, idle_sleep_s=0.001,
-                 clock=None):
+                 key_prefix="prefix", trace_gossip=True,
+                 trace_key_prefix="traces", trace_max_traces=64,
+                 warmup=True, idle_sleep_s=0.001, clock=None):
         if callable(engine_or_factory) and \
                 not hasattr(engine_or_factory, "step"):
             self.engine = engine_or_factory()
@@ -70,11 +80,22 @@ class ReplicaServer:
         self.idle_sleep_s = float(idle_sleep_s)
         self.steps = 0
         self.publisher = None
+        self.trace_publisher = None
         if store is not None:
             self.publisher = PrefixSummaryPublisher(
                 self.engine, self.replica_id, store,
                 key_prefix=key_prefix, max_entries=gossip_max_entries,
                 clock=clock)
+            if trace_gossip and \
+                    getattr(self.engine, "tracer", None) is not None:
+                self.trace_publisher = TraceRingPublisher(
+                    self.engine.tracer, self.replica_id, store,
+                    key_prefix=trace_key_prefix,
+                    max_traces=trace_max_traces, clock=clock)
+
+    def _publishers(self):
+        return [p for p in (self.publisher, self.trace_publisher)
+                if p is not None]
 
     def step(self):
         """One scheduler step (inline-driving hook for tests)."""
@@ -83,18 +104,19 @@ class ReplicaServer:
 
     def serve(self, should_stop=None, max_steps=None):
         """Drive the engine until ``should_stop()`` (or ``max_steps``
-        scheduler steps).  The gossip publisher thread runs for exactly
-        this loop's lifetime and pushes one final summary on the way
+        scheduler steps).  The gossip publisher threads run for exactly
+        this loop's lifetime and push one final payload on the way
         out, so a replica that drained-and-exited leaves its last
-        (usually empty) summary behind, not a stale warm one.  Returns
-        the number of steps served."""
+        summary (and its final trace ring — the fleet view keeps its
+        segments) behind, not a stale mid-run one.  Returns the number
+        of steps served."""
         if should_stop is None and max_steps is None:
             raise ValueError("serve() needs should_stop and/or "
                              "max_steps — an unbounded serve loop has "
                              "no exit")
         served = 0
-        if self.publisher is not None:
-            self.publisher.start(self.gossip_interval_s)
+        for pub in self._publishers():
+            pub.start(self.gossip_interval_s)
         try:
             # lint-ok: bounded-retries the loop's bound is the caller's
             # should_stop()/max_steps, validated non-None above — a
@@ -110,21 +132,21 @@ class ReplicaServer:
                 else:
                     time.sleep(self.idle_sleep_s)
         finally:
-            if self.publisher is not None:
-                self.publisher.stop()
+            for pub in self._publishers():
+                pub.stop()
                 try:
-                    self.publisher.publish()
+                    pub.publish()
                 except Exception:
                     pass    # silent-ok: a flaky store at shutdown
                     #         cannot matter — collectors treat the
                     #         absent/stale key as a cold replica
 
     def __enter__(self):
-        if self.publisher is not None:
-            self.publisher.start(self.gossip_interval_s)
+        for pub in self._publishers():
+            pub.start(self.gossip_interval_s)
         return self
 
     def __exit__(self, *exc):
-        if self.publisher is not None:
-            self.publisher.stop()
+        for pub in self._publishers():
+            pub.stop()
         return False

@@ -74,7 +74,7 @@ import threading
 import time
 from collections import deque
 
-from ..observability.tracing import Tracer, default_tracer
+from ..observability.tracing import Tracer, activate, default_tracer
 from ..resilience.retry import backoff_delays
 from .engine import Engine, RequestState, SamplingParams
 from .kv_cache import prefix_hashes
@@ -322,7 +322,8 @@ class FleetRouter:
                 if freq.t_first_token is None and freq.tokens_out:
                     freq.t_first_token = self._clock()
                     self.metrics.ttft.observe(
-                        freq.t_first_token - freq.t_submit)
+                        freq.t_first_token - freq.t_submit,
+                        exemplar=getattr(freq._span, "trace_id", None))
             if ereq.state == RequestState.FINISHED:
                 del table[freq.id]
                 self._finish(freq, FleetRequestState.FINISHED,
@@ -336,12 +337,15 @@ class FleetRouter:
                 finished.append(freq)
 
     # ------------------------------------------------------------ failure
-    def _reclaim(self, rep):
+    def _reclaim(self, rep, reason="failover", exc=None):
         """Pull every request assigned to ``rep`` back into the router
         queue (front, original order), each exactly once.  Only tokens
         harvested after a completed step ride along — the re-dispatch
         admission is ``prompt + tokens_out``, so the next replica
-        rebuilds KV state from scratch and cannot double-emit."""
+        rebuilds KV state from scratch and cannot double-emit.  Each
+        moved request gets a ``router::failover`` child span on ITS OWN
+        fleet trace — the original trace continues through re-dispatch
+        instead of being severed at the most interesting moment."""
         with self._lock:
             table = self._assigned[rep.replica_id]
             moved = list(table.values())
@@ -353,11 +357,21 @@ class FleetRouter:
                 rep.engine.evacuate()
             except Exception:
                 pass  # silent-ok: a hard-dead engine has nothing to free
+            now = self._clock()
             for freq in reversed(moved):
                 freq.state = FleetRequestState.PENDING
                 freq.replica_id = None
                 freq._engine_req = None
                 freq.redispatches += 1
+                if freq._span is not None:
+                    self.tracer.start_span(
+                        "router::failover", freq._span, start_s=now,
+                        attributes={
+                            "replica": rep.replica_id, "reason": reason,
+                            "error": (repr(exc) if exc is not None
+                                      else None),
+                            "harvested_tokens": len(freq.tokens_out),
+                        }).end(now)
                 self._pending.appendleft(freq)
                 self.metrics.redispatched.inc()
             self.metrics.pending_depth.set(len(self._pending))
@@ -380,13 +394,10 @@ class FleetRouter:
         rid = str(rep.replica_id)
         self.metrics.breaker_open.labels(replica=rid).set(1)
         self.metrics.failovers.labels(replica=rid, reason=reason).inc()
-        span = self.tracer.start_trace(
-            "router::failover",
-            attributes={"replica": rep.replica_id, "reason": reason,
-                        "error": repr(exc) if exc is not None else None})
-        moved = self._reclaim(rep)
-        span.set_attribute("redispatched", len(moved))
-        span.end()
+        # no standalone failover trace: the event lands as a
+        # router::failover span on every affected request's own trace
+        # (see _reclaim), so the timeline survives the re-dispatch
+        self._reclaim(rep, reason=reason, exc=exc)
         self._update_gauges()
 
     # ---------------------------------------------------- prefix gossip
@@ -480,20 +491,44 @@ class FleetRouter:
                 return "evicted"
             kw["ttl_s"] = remaining
         esp = dataclasses.replace(freq.sampling, **kw)
+        # the dispatch span is a CHILD of the fleet trace, opened
+        # *before* admission so its context rides ``add_request`` into
+        # the engine: the replica's request#N segment parents here, and
+        # a fault firing inside admission lands on this span (activate)
+        dattrs = {"request_id": freq.id, "replica": rep.replica_id,
+                  "expected_prefix_hit_tokens": expected_hit,
+                  "redispatch": freq.redispatches > 0}
+        if freq._span is not None:
+            dspan = self.tracer.start_span("router::dispatch", freq._span,
+                                           start_s=now, attributes=dattrs)
+        else:
+            dspan = self.tracer.start_trace("router::dispatch",
+                                            start_s=now, attributes=dattrs)
         t0 = _wall()
         try:
-            ereq = rep.engine.add_request(freq.prompt + freq.tokens_out,
-                                          esp)
+            with activate(dspan):
+                ereq = rep.engine.add_request(
+                    freq.prompt + freq.tokens_out, esp,
+                    trace_context=dspan.context())
         except OSError as e:
+            dspan.set_attributes({"outcome": "replica_failed",
+                                  "error": repr(e)}).end()
             self._on_replica_failure(rep, "io_error", e)
             return "failed"
+        except BaseException as e:
+            # SimulatedCrash (and any other non-OSError) rides through;
+            # the span still closes so the trace shows where it died
+            dspan.set_attribute("error", repr(e)).end()
+            raise
         stalled = (_wall() - t0) > self.stall_timeout_s
         if ereq.state == RequestState.RETRY_AFTER:
+            dspan.set_attribute("outcome", "backpressure").end()
             self._backpressure(rep, ereq.retry_after_s, now)
             if stalled:
                 self._on_replica_failure(rep, "stall")
             return "backpressure"
         if ereq.state == RequestState.REJECTED:
+            dspan.set_attribute("outcome", "rejected").end()
             self._pending.popleft()
             self._finish(freq, FleetRequestState.REJECTED,
                          ereq.finish_reason)
@@ -510,12 +545,7 @@ class FleetRouter:
         self.metrics.dispatches.labels(replica=str(rep.replica_id)).inc()
         if expected_hit > 0:
             self.metrics.cache_aware_dispatches.inc()
-        self.tracer.start_trace(
-            "router::dispatch", start_s=now,
-            attributes={"request_id": freq.id,
-                        "replica": rep.replica_id,
-                        "expected_prefix_hit_tokens": expected_hit,
-                        "redispatch": freq.redispatches > 0}).end(now)
+        dspan.set_attribute("outcome", "dispatched").end()
         if stalled:
             # admission wedge (serving.admit stall site): the request IS
             # assigned, so the failure path reclaims it exactly once
@@ -596,7 +626,7 @@ class FleetRouter:
         return rep
 
     def _finish_drain(self, rep, now):
-        stragglers = self._reclaim(rep)
+        stragglers = self._reclaim(rep, reason="drain_deadline")
         if rep._drain_span is not None:
             rep._drain_span.set_attributes(
                 {"stragglers": len(stragglers),
@@ -862,3 +892,31 @@ class FleetRouter:
             except Exception as e:
                 out["autoscaler"] = {"error": repr(e)}
         return out
+
+    def collect_traces(self, limit=None):
+        """The in-process fleet trace view: the router's ring plus each
+        live replica engine's ring, merged by trace_id
+        (:func:`~paddle_tpu.observability.tracing.merge_traces`) — the
+        ``/traces?fleet=1`` payload when the fleet shares one process.
+        Tracer objects shared between router and engines (the
+        default-tracer case) are read once; a replica whose tracer is
+        unreachable (hard-killed engine stub) is skipped — exactly the
+        information a SIGKILLed process would lose.  Cross-process
+        fleets use the store-plane
+        :func:`~paddle_tpu.observability.trace_gossip.collect_fleet_traces`
+        instead."""
+        from ..observability.tracing import merge_traces
+
+        rings = [("router", self.tracer.traces(limit=limit))]
+        seen = {id(self.tracer)}
+        for rep in self.replicas:
+            try:
+                tracer = rep.engine.tracer
+            except Exception:
+                continue    # silent-ok: a dead engine's ring died with it
+            if tracer is None or id(tracer) in seen:
+                continue
+            seen.add(id(tracer))
+            rings.append((f"replica{rep.replica_id}",
+                          tracer.traces(limit=limit)))
+        return merge_traces(rings)

@@ -239,7 +239,11 @@ def run_soak(engine_factory, traffic, horizon_s, *,
         try:
             scraped = {"url": server.url,
                        "fleet": _get_json(server.url + "/fleet"),
-                       "flight": _get_json(server.url + "/flight")}
+                       "flight": _get_json(server.url + "/flight"),
+                       # the merged fleet trace view: a hard-killed-and-
+                       # failed-over request must read as ONE trace here
+                       "traces": _get_json(
+                           server.url + "/traces?fleet=1")}
             try:
                 scraped["healthz"] = _get_json(server.url + "/healthz")
                 scraped["healthz_ok"] = True

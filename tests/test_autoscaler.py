@@ -73,7 +73,7 @@ class _StubEngine:
                 "decode_rate_tok_s": self.rate,
                 "prefix_cache": {"enabled": True}}
 
-    def add_request(self, prompt, sampling):
+    def add_request(self, prompt, sampling, trace_context=None):
         req = _StubReq(prompt, sampling)
         self.reqs.append(req)
         return req

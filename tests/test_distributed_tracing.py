@@ -1,0 +1,492 @@
+"""Fleet-wide distributed tracing: TraceContext propagation across
+tracers/processes, tail-based retention under ring pressure, histogram
+exemplars in the OpenMetrics exposition, the trace-gossip store plane,
+the merged fleet view (``merge_traces`` + ``/traces?fleet=1``), and the
+hard-kill-failover acceptance — ONE trace per re-dispatched request,
+asserted over live HTTP from the merged fleet view."""
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
+from paddle_tpu.observability.exporter import start_telemetry_server
+from paddle_tpu.observability.metrics import Histogram, MetricsRegistry
+from paddle_tpu.observability.tracing import (TailRetention, TraceContext,
+                                              Tracer, activate,
+                                              export_traces_chrome,
+                                              merge_traces)
+from paddle_tpu.resilience import FaultSpec, fault_point, injected_faults
+from paddle_tpu.serving import (Engine, FleetRequestState, FleetRouter,
+                                SamplingParams)
+from paddle_tpu.serving.metrics import ServingMetrics
+
+
+class ManualClock:
+    def __init__(self, auto=0.0):
+        self.t = 0.0
+        self.auto = auto
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        self.t += self.auto
+        return self.t
+
+
+def _tiny_cfg():
+    return dataclasses.replace(GPT_CONFIGS["tiny"], dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny_cfg()
+    params = gpt_init(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _get_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+# ------------------------------------------------ context propagation
+
+
+class TestTraceContextPropagation:
+    def test_nonce_prefixed_ids_never_collide_across_tracers(self):
+        a, b = Tracer(clock=ManualClock(auto=1.0)), \
+            Tracer(clock=ManualClock(auto=1.0))
+        ra, rb = a.start_trace("op"), b.start_trace("op")
+        assert ra.trace_id != rb.trace_id
+        assert ra.span_id != rb.span_id
+        assert a.nonce != b.nonce
+        assert ra.trace_id.startswith(a.nonce)
+
+    def test_context_json_round_trip_continues_trace(self):
+        """The cross-process shape: a context serialized to JSON in one
+        tracer re-roots a segment under the SAME trace_id in another,
+        parented to the originating span."""
+        router_tr = Tracer(clock=ManualClock(auto=1.0))
+        replica_tr = Tracer(clock=ManualClock(auto=1.0))
+        root = router_tr.start_trace("fleet#0")
+        dispatch = router_tr.start_span("router::dispatch", root)
+        wire = json.dumps(dispatch.context().to_dict())   # crosses the wire
+
+        ctx = TraceContext.from_dict(json.loads(wire))
+        seg = replica_tr.start_trace("request#0", context=ctx)
+        assert seg.trace_id == root.trace_id
+        assert seg.parent_id == dispatch.span_id
+        child = replica_tr.start_span("decode[1]", seg)
+        child.end()
+        seg.end()
+        dispatch.end()
+        root.end()
+
+        (remote,) = replica_tr.traces()
+        assert remote["trace_id"] == root.trace_id
+        merged = merge_traces([("router", router_tr.traces()),
+                               ("replica0", replica_tr.traces())])
+        (m,) = merged                        # ONE trace, two segments
+        assert m["trace_id"] == root.trace_id
+        assert m["name"] == "fleet#0"        # origin segment names it
+        assert len(m["segments"]) == 2
+        sources = {s["source"] for s in m["spans"]}
+        assert sources == {"router", "replica0"}
+        by_name = {s["name"]: s for s in m["spans"]}
+        assert by_name["request#0"]["parent_id"] == \
+            by_name["router::dispatch"]["span_id"]
+
+    def test_context_joins_live_trace_in_same_tracer(self):
+        """In-process fleets share one tracer: a context-continued
+        start_trace joins the LIVE trace as an ordinary child — no
+        split segments to merge."""
+        tr = Tracer(clock=ManualClock(auto=1.0))
+        root = tr.start_trace("fleet#1")
+        seg = tr.start_trace("request#1", context=root.context())
+        assert seg.trace_id == root.trace_id
+        seg.end()
+        assert tr.traces() == []             # still one live trace
+        root.end()
+        (done,) = tr.traces()
+        assert {s["name"] for s in done["spans"]} == \
+            {"fleet#1", "request#1"}
+
+    def test_disabled_tracer_propagates_no_context(self):
+        tr = Tracer(enabled=False)
+        span = tr.start_trace("op")
+        assert span.context() is None
+        assert tr.start_span("child", span) is span   # shared null span
+        span.end()
+        assert tr.traces() == []
+
+
+# ------------------------------------------------- tail-based retention
+
+
+class TestTailRetention:
+    def _finish(self, tr, name, attrs=None, dur=0.001):
+        clk = tr.clock
+        root = tr.start_trace(name, attributes=attrs, start_s=clk.t)
+        root.end(clk.t + dur)
+        clk.advance(dur)
+
+    def test_interesting_survive_ring_pressure(self):
+        """Under ring pressure the boring sampled traces are evicted
+        first; shed/evicted/failover/slow traces survive a flood of
+        boring ones that overflows the ring many times over."""
+        clk = ManualClock()
+        tr = Tracer(clock=clk, max_traces=8,
+                    retention=TailRetention(slow_threshold_s=0.5))
+        self._finish(tr, "req#shed", {"state": "retry_after"})
+        self._finish(tr, "req#evicted", {"state": "evicted"})
+        self._finish(tr, "req#error", {"error": "OSError('boom')"})
+        self._finish(tr, "req#slow", dur=0.9)
+        root = tr.start_trace("req#failover", start_s=clk.t)
+        tr.start_span("router::failover", root, start_s=clk.t).end(clk.t)
+        root.end(clk.t)
+        for i in range(50):                  # 6x the ring of boredom
+            self._finish(tr, f"boring#{i}")
+        kept = {t["name"]: t["retained"] for t in tr.traces()}
+        assert kept["req#shed"] == "retry_after"
+        assert kept["req#evicted"] == "evicted"
+        assert kept["req#error"] == "error"
+        assert kept["req#slow"] == "slow"
+        assert kept["req#failover"] == "failover"
+        assert len(tr.traces()) == 8         # ring stays bounded
+        assert sum(1 for r in kept.values() if r == "sampled") == 3
+
+    def test_boring_traces_sampled_out(self):
+        clk = ManualClock()
+        tr = Tracer(clock=clk, max_traces=64,
+                    retention=TailRetention(sample_rate=0.0))
+        for i in range(20):
+            self._finish(tr, f"boring#{i}")
+        self._finish(tr, "req#evicted", {"state": "evicted"})
+        assert [t["name"] for t in tr.traces()] == ["req#evicted"]
+        s = tr.summary()
+        assert s["completed"] == 21 and s["dropped"] == 20
+        assert s["retained_by_reason"] == {"evicted": 1}
+
+    def test_sampling_is_seeded_and_probabilistic(self):
+        def run(seed):
+            clk = ManualClock()
+            tr = Tracer(clock=clk, max_traces=4096,
+                        retention=TailRetention(sample_rate=0.1,
+                                                seed=seed))
+            for i in range(1000):
+                self._finish(tr, f"b#{i}")
+            return [t["name"] for t in tr.traces()]
+
+        a, b = run(7), run(7)
+        assert a == b                        # reproducible
+        assert 40 <= len(a) <= 250           # ~10% of 1000
+
+    def test_fired_fault_pins_trace_in_ring(self):
+        """A fired fault lands a (site, kind, occurrence, seed) event on
+        the thread's ambient span, and retention classifies the trace as
+        always-keep."""
+        clk = ManualClock()
+        tr = Tracer(clock=clk, max_traces=4,
+                    retention=TailRetention(sample_rate=0.0))
+        root = tr.start_trace("req#faulted", start_s=clk.t)
+        with injected_faults(FaultSpec("test.site", "stall", stall_s=0.0),
+                             seed=42):
+            with activate(root):
+                fault_point("test.site")
+        root.end(clk.t)
+        (done,) = tr.traces()
+        assert done["retained"] == "fault"
+        (event,) = done["spans"][0]["attributes"]["faults"]
+        assert event == {"site": "test.site", "kind": "stall",
+                         "occurrence": 1, "seed": 42}
+
+
+# ---------------------------------------------------- histogram exemplars
+
+
+class TestHistogramExemplars:
+    def test_exposition_carries_bucket_exemplars(self):
+        reg = MetricsRegistry()
+        h = reg.register(Histogram("demo_seconds"))
+        h.observe(0.004, exemplar="abc.t7")
+        h.observe(123.0, exemplar="abc.t9")   # overflow (+Inf) bucket
+        h.observe(0.004)                      # exemplar-less: no change
+        ex = h.exemplars()
+        # log buckets from 1e-4 at factor 2: 0.004 lands in le=0.0064
+        assert ex["0.0064"] == {"trace_id": "abc.t7", "value": 0.004}
+        assert ex["+Inf"] == {"trace_id": "abc.t9", "value": 123.0}
+        text = reg.expose_prometheus()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith('demo_seconds_bucket{le="0.0064"}'))
+        assert '# {trace_id="abc.t7"} 0.004' in line
+        inf = next(ln for ln in text.splitlines()
+                   if ln.startswith('demo_seconds_bucket{le="+Inf"}'))
+        assert '# {trace_id="abc.t9"} 123' in inf
+
+    def test_ttft_exemplar_resolves_to_retained_trace(self, tiny_model):
+        """Acceptance: the serving_ttft_seconds exposition carries an
+        exemplar trace_id that resolves to a retained trace in the
+        engine's ring — grafana's histogram-to-trace jump works."""
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, page_size=8, num_pages=64,
+                     max_batch_size=2, chunk_len=8,
+                     clock=ManualClock(auto=0.001))
+        eng.metrics = ServingMetrics(MetricsRegistry())
+        eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
+        ex = eng.metrics.ttft.exemplars()
+        assert ex, "TTFT observation recorded no exemplar"
+        tids = {e["trace_id"] for e in ex.values()}
+        ring = {t["trace_id"] for t in eng.tracer.traces()}
+        assert tids <= ring
+        text = eng.metrics.registry.expose_prometheus()
+        assert any(f'trace_id="{t}"' in text for t in tids)
+
+
+# ------------------------------------------------------ trace gossip
+
+
+class TestTraceGossip:
+    def _split_fleet_traces(self):
+        """Router + two replica tracers, one request failed over across
+        both replicas — the real split-ring topology."""
+        router_tr = Tracer(clock=ManualClock(auto=1.0))
+        reps = [Tracer(clock=ManualClock(auto=1.0)) for _ in range(2)]
+        root = router_tr.start_trace("fleet#0")
+        d0 = router_tr.start_span("router::dispatch", root)
+        seg0 = reps[0].start_trace("request#0", context=d0.context())
+        seg0.set_attribute("state", "evacuated")
+        seg0.end()
+        d0.end()
+        fo = router_tr.start_span("router::failover", root)
+        fo.end()
+        d1 = router_tr.start_span("router::dispatch", root)
+        seg1 = reps[1].start_trace("request#0", context=d1.context())
+        seg1.end()
+        d1.end()
+        root.end()
+        return router_tr, reps
+
+    def test_publish_collect_merge_round_trip(self, tmp_path):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.observability.trace_gossip import (
+            TraceRingPublisher, collect_fleet_traces, collect_trace_rings)
+
+        router_tr, reps = self._split_fleet_traces()
+        store = TCPStore(is_master=True, world_size=1)
+        pubs = [TraceRingPublisher(tr, rid, store)
+                for rid, tr in enumerate(reps)]
+        for pub in pubs:
+            pub.publish()
+        rings = collect_trace_rings(store, [0, 1, 2])   # 2 never published
+        assert [src for src, _ in rings] == ["replica0", "replica1"]
+
+        merged = collect_fleet_traces(
+            store, [0, 1],
+            extra_rings=[("router", router_tr.traces())])
+        (m,) = merged                        # ONE trace across 3 rings
+        assert len(m["segments"]) == 3
+        assert m["name"] == "fleet#0"
+        assert m["retained"] == "failover"   # strongest reason wins
+        sources = [s["source"] for s in m["spans"]]
+        assert {"router", "replica0", "replica1"} == set(sources)
+
+        # chrome export of the merged view: integer tracks, labels
+        # carry the source so the timeline reads across processes
+        path = str(tmp_path / "fleet.json")
+        export_traces_chrome(merged, path)
+        with open(path) as f:
+            evs = json.load(f)["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert all(isinstance(e["tid"], int) for e in xs)
+        assert any(e["name"] == "replica1: request#0" for e in xs)
+        assert any(e["name"] == "router: router::failover" for e in xs)
+
+    def test_garbled_and_stale_rings_absent(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.observability.trace_gossip import (
+            TraceRingPublisher, collect_trace_rings)
+
+        store = TCPStore(is_master=True, world_size=1)
+        store.set("traces/replica_0", "}{ not json")
+        tr = Tracer(clock=ManualClock(auto=1.0))
+        tr.start_trace("op").end()
+        TraceRingPublisher(tr, 1, store,
+                           clock=lambda: 100.0).publish()
+        rings = collect_trace_rings(store, [0, 1])
+        assert [src for src, _ in rings] == ["replica1"]     # 0 garbled
+        assert collect_trace_rings(store, [0, 1], stale_after_s=5.0,
+                                   clock=lambda: 200.0) == []
+        fresh = collect_trace_rings(store, [0, 1], stale_after_s=5.0,
+                                    clock=lambda: 101.0)
+        assert [src for src, _ in fresh] == ["replica1"]
+
+    def test_publisher_payload_bounds_and_stamps(self):
+        from paddle_tpu.observability.trace_gossip import TraceRingPublisher
+
+        class _Sink:
+            def set(self, key, value):
+                self.last = (key, value)
+
+        tr = Tracer(clock=ManualClock(auto=1.0))
+        for i in range(10):
+            tr.start_trace(f"t{i}").end()
+        pub = TraceRingPublisher(tr, 3, _Sink(), max_traces=4)
+        payload = pub.publish()
+        assert payload["replica"] == 3
+        assert len(payload["traces"]) == 4   # newest win the slots
+        assert payload["traces"][-1]["name"] == "t9"
+        assert "clock_offset_s" in payload
+        key, raw = pub.store.last
+        assert key == "traces/replica_3"
+        json.loads(raw)                      # JSON on the wire
+
+
+# ---------------------------------------- fleet failover over live HTTP
+
+
+def _factory(cfg, params, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("chunk_len", 8)
+
+    def make():
+        # a private tracer per engine: the split-ring topology a real
+        # per-process fleet has (the shared-default-tracer in-process
+        # shape is covered by the soak test)
+        return Engine(cfg, params, tracer=Tracer(), **kw)
+
+    return make
+
+
+@pytest.mark.faultinject
+class TestFleetFailoverTraceHTTP:
+    def test_hard_kill_yields_one_merged_trace_over_http(self, tiny_model):
+        """Acceptance: hard-kill a replica mid-decode; every
+        re-dispatched request reads as ONE trace — original dispatch,
+        failover hop, re-dispatch, and the surviving replica's request
+        segment — in the merged fleet view scraped from
+        ``/traces?fleet=1`` over live HTTP."""
+        cfg, params = tiny_model
+        registry = MetricsRegistry()
+        router = FleetRouter([_factory(cfg, params)] * 2,
+                             tracer=Tracer(), registry=registry)
+        rng = np.random.RandomState(11)
+        prompts = [list(rng.randint(0, cfg.vocab_size, n))
+                   for n in (5, 9, 7, 12)]
+        reqs = [router.submit(p, SamplingParams(max_new_tokens=8))
+                for p in prompts]
+        # the root span is released when a request finishes — snapshot
+        # the trace ids while the traces are in flight
+        tids = {r.id: r._span.trace_id for r in reqs}
+        for _ in range(3):
+            router.step()
+        assert any(r.tokens_out for r in reqs)
+        victim = next(r.replica_id for r in reqs
+                      if r.replica_id is not None)
+        router.kill_replica(victim)
+        while router.has_work():
+            router.step()
+        assert all(r.state == FleetRequestState.FINISHED for r in reqs)
+        moved = [r for r in reqs if r.redispatches == 1]
+        assert moved, "the kill moved no request"
+
+        server = start_telemetry_server(port=0, registry=registry,
+                                        tracer=router.tracer,
+                                        router=router)
+        try:
+            body = _get_json(server.url + "/traces?fleet=1")
+        finally:
+            server.stop()
+        assert body["fleet"] is True
+        merged = {t["trace_id"]: t for t in body["traces"]}
+        # one entry per trace_id — by construction of the merge, but
+        # assert it on the wire anyway
+        assert len(body["traces"]) == len(merged)
+        for r in moved:
+            tr = merged[tids[r.id]]          # present, exactly once
+            names = [s["name"] for s in tr["spans"]]
+            assert names.count("router::dispatch") == 2
+            assert "router::failover" in names
+            assert tr["retained"] == "failover"
+            # the surviving replica's segment landed under the same
+            # trace (the victim's unpublished ring died with it)
+            survivor = f"replica{r.replica_id}"
+            seg_sources = {s["source"] for s in tr["segments"]}
+            assert survivor in seg_sources and "router" in seg_sources
+            req_seg = [s for s in tr["spans"]
+                       if s["source"] == survivor and
+                       s["name"].startswith("request#")]
+            assert req_seg, tr["spans"]
+        # un-moved requests: one dispatch, no failover hop
+        for r in reqs:
+            if r.redispatches:
+                continue
+            names = [s["name"] for s in merged[tids[r.id]]["spans"]]
+            assert names.count("router::dispatch") == 1
+            assert "router::failover" not in names
+
+
+# ------------------------------------------------- concurrent scrape
+
+
+class TestConcurrentScrape:
+    def test_fleet_scrape_during_generate_is_torn_read_free(
+            self, tiny_model):
+        """Scrape ``/traces`` and ``/traces?fleet=1`` continuously while
+        the fleet decodes: every response parses, every trace is
+        internally consistent (root-first spans, window covers every
+        span) — no torn reads from the rings under mutation."""
+        cfg, params = tiny_model
+        registry = MetricsRegistry()
+        router = FleetRouter([_factory(cfg, params)] * 2,
+                             tracer=Tracer(), registry=registry)
+        server = start_telemetry_server(port=0, registry=registry,
+                                        tracer=router.tracer,
+                                        router=router)
+        errors, bodies = [], []
+        stop = threading.Event()
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    bodies.append(_get_json(server.url + "/traces"))
+                    bodies.append(
+                        _get_json(server.url + "/traces?fleet=1"))
+                except Exception as e:       # noqa: BLE001 - collected
+                    errors.append(repr(e))
+
+        t = threading.Thread(target=scrape, daemon=True)
+        try:
+            t.start()
+            rng = np.random.RandomState(5)
+            reqs = [router.submit(list(rng.randint(0, cfg.vocab_size, 6)),
+                                  SamplingParams(max_new_tokens=6))
+                    for _ in range(6)]
+            while router.has_work():
+                router.step()
+            assert all(r.state == FleetRequestState.FINISHED
+                       for r in reqs)
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+            server.stop()
+        assert errors == []
+        assert len(bodies) >= 2
+        for body in bodies:
+            for tr in body["traces"]:
+                spans = tr["spans"]
+                assert spans, tr
+                for s in spans:
+                    assert s["trace_id"] == tr["trace_id"]
+                    assert tr["start_s"] <= s["start_s"]
+                    if s["end_s"] is not None and tr["end_s"] is not None:
+                        assert s["end_s"] <= tr["end_s"] + 1e-9

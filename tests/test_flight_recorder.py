@@ -512,3 +512,32 @@ class TestMetricNamesLint:
         assert "foo_total" in text and "one name, one type" in text
         assert "commented_out" not in text
         assert len(violations) == 3
+
+
+# --------------------------------------------------- tracing overhead smoke
+
+
+class TestTracingOverheadSmoke:
+    def test_implied_request_overhead_under_bound(self):
+        """Acceptance: a full request-shaped trace lifecycle, scaled to
+        a documented 50 ms TTFT-class request, stays under the 1% bound
+        ``bench --section tracing`` publishes — with tail retention at
+        full sampling (the default posture)."""
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "bench.py")
+        spec = importlib.util.spec_from_file_location("bench_mod", path)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        out = bench.bench_tracing(iters=900, reps=3)
+        assert out["implied_request_overhead_ratio"] < \
+            out["bound_ratio"], out
+        # absolute sanity: tens of microseconds per request, not ms
+        assert out["per_request_full_us"] < 1000, out
+        # the disabled posture must be dramatically cheaper (null span)
+        assert out["per_request_disabled_us"] < \
+            out["per_request_full_us"], out
+        # and sampled retention must actually shed boring traces
+        assert out["ring_sampled"]["dropped"] > 0, out

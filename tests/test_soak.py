@@ -118,3 +118,24 @@ class TestCompressedSoak:
         soak_ops = {op for op in flight_ops if op.startswith("soak::")}
         assert {"soak::kill", "soak::stall_admit", "soak::stall_poll",
                 "soak::spawn_io_error"} <= soak_ops, flight_ops
+
+        # ---- merged fleet trace view over live HTTP: a hard-killed-
+        # and-failed-over request reads as ONE trace — one entry per
+        # trace_id, the failover hop and both dispatches on it
+        traces = scraped["traces"]
+        assert traces["fleet"] is True
+        merged = traces["traces"]
+        tids = [t["trace_id"] for t in merged]
+        assert len(tids) == len(set(tids)), "trace split across entries"
+        if report["redispatched"]:
+            failed_over = [
+                t for t in merged
+                if any(s["name"] == "router::failover"
+                       for s in t["spans"])]
+            assert failed_over, "redispatches left no failover trace"
+            for t in failed_over:
+                names = [s["name"] for s in t["spans"]]
+                assert names.count("router::dispatch") >= 2, names
+                # tail retention pinned it (failover, or a stronger
+                # reason like a fault event recorded on a span)
+                assert t["retained"] != "sampled", t["retained"]

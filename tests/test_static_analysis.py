@@ -58,10 +58,11 @@ from tools.analysis.passes import (collective_discipline,  # noqa: E402
 
 ALL_RULES = {"atomic-writes", "metric-names", "fault-sites",
              "collective-instrumented", "bounded-retries", "excepts",
-             "lock-discipline", "trace-purity",
+             "lock-discipline", "trace-purity", "span-discipline",
              "collective-discipline", "sharding-spec"}
 
 LEGACY_RULES = ALL_RULES - {"lock-discipline", "trace-purity",
+                            "span-discipline",
                             "collective-discipline", "sharding-spec"}
 
 
@@ -620,6 +621,145 @@ class TestTracePurity:
         assert "models/gpt.py" in blob
 
 
+# ========================================================= span-discipline
+
+class TestSpanDiscipline:
+    def test_discarded_start_call_flagged(self, tmp_path):
+        src = """\
+        def handle(tracer):
+            tracer.start_trace("req")
+            return 1
+        """
+        p = _project(tmp_path, {"m.py": src})
+        flagged = _findings("span-discipline", p)
+        assert len(flagged) == 1
+        assert "discarded" in flagged[0].message
+        assert "handle()" in flagged[0].message
+
+    def test_chained_end_and_mutator_chain_ok(self, tmp_path):
+        src = """\
+        def zero_width(tracer, now):
+            tracer.start_span("evt", None, start_s=now).end(now)
+
+        def via_mutator(tracer, now):
+            tracer.start_trace("evt").set_attribute("k", 1).end(now)
+        """
+        p = _project(tmp_path, {"m.py": src})
+        assert _findings("span-discipline", p) == []
+
+    def test_with_statement_and_escapes_ok(self, tmp_path):
+        src = """\
+        def ctx(tracer):
+            with tracer.start_trace("req") as span:
+                span.set_attribute("k", 1)
+
+        def stored(tracer, req):
+            req._span = tracer.start_trace("req")
+
+        def returned(tracer):
+            return tracer.start_trace("req")
+
+        def handed_off(tracer, sink):
+            span = tracer.start_trace("req")
+            sink(span)
+
+        def packed(tracer, out):
+            span = tracer.start_trace("req")
+            out.append(span)
+        """
+        p = _project(tmp_path, {"m.py": src})
+        assert _findings("span-discipline", p) == []
+
+    def test_local_never_ended_flagged(self, tmp_path):
+        src = """\
+        def leak(tracer):
+            span = tracer.start_trace("req")
+            span.set_attribute("k", 1)
+            return 1
+        """
+        p = _project(tmp_path, {"m.py": src})
+        flagged = _findings("span-discipline", p)
+        assert len(flagged) == 1
+        assert "span 'span'" in flagged[0].message
+        assert "return with span open" in flagged[0].message
+        assert flagged[0].line == 2
+
+    def test_return_on_one_branch_while_open_flagged(self, tmp_path):
+        src = """\
+        def race(tracer, fast):
+            span = tracer.start_trace("req")
+            if fast:
+                return 0
+            span.end()
+            return 1
+        """
+        p = _project(tmp_path, {"m.py": src})
+        flagged = _findings("span-discipline", p)
+        assert len(flagged) == 1
+        assert "return with span open (line 4)" in flagged[0].message
+
+    def test_all_branches_end_ok(self, tmp_path):
+        src = """\
+        def branchy(tracer, ok):
+            span = tracer.start_trace("req")
+            if ok:
+                span.set_attribute("outcome", "ok")
+                span.end()
+            else:
+                span.set_attribute("outcome", "bad")
+                span.end()
+            return 1
+        """
+        p = _project(tmp_path, {"m.py": src})
+        assert _findings("span-discipline", p) == []
+
+    def test_try_finally_end_covers_raise_paths(self, tmp_path):
+        src = """\
+        def guarded(tracer, work):
+            span = tracer.start_trace("req")
+            try:
+                work()
+            finally:
+                span.end()
+            return 1
+        """
+        p = _project(tmp_path, {"m.py": src})
+        assert _findings("span-discipline", p) == []
+
+    def test_fallthrough_open_flagged_and_suppression(self, tmp_path):
+        src = """\
+        def drops(tracer):
+            span = tracer.start_trace("req")
+            span.set_attribute("k", 1)
+
+        def vetted(tracer):
+            # lint-ok: span-discipline force-ended by root end at exit
+            span = tracer.start_trace("req")
+            span.set_attribute("k", 1)
+        """
+        p = _project(tmp_path, {"m.py": src})
+        flagged = _findings("span-discipline", p)
+        assert len(flagged) == 1
+        assert "fallthrough with span open" in flagged[0].message
+        assert flagged[0].line == 2
+
+    def test_nested_function_is_its_own_unit(self, tmp_path):
+        src = """\
+        def outer(tracer):
+            def inner():
+                s = tracer.start_trace("inner")
+                s.end()
+            return inner
+        """
+        p = _project(tmp_path, {"m.py": src})
+        assert _findings("span-discipline", p) == []
+
+    def test_repo_is_clean(self):
+        flagged = apply_suppressions(
+            Project(), REGISTRY["span-discipline"](Project()))
+        assert flagged == [], "\n".join(str(f) for f in flagged)
+
+
 # ===================================================== migrated lint shims
 
 class TestMigratedShims:
@@ -671,7 +811,7 @@ class TestTier1Suite:
             [sys.executable, "-m", "tools.analysis"], cwd=REPO,
             capture_output=True, text=True, timeout=120)
         assert proc.returncode == 0, proc.stdout + proc.stderr
-        assert "10 passes" in proc.stdout
+        assert "11 passes" in proc.stdout
 
     def test_lock_order_graph_is_exposed(self):
         # bench/debug introspection surface: the cross-module edge list
@@ -1359,7 +1499,7 @@ class TestRouterLockRegression:
             def evacuate(self):
                 pass
 
-            def add_request(self, prompt, sampling):
+            def add_request(self, prompt, sampling, trace_context=None):
                 return _Req()
 
         return FleetRouter([_Eng()])

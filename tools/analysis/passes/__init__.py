@@ -10,11 +10,12 @@ from tools.analysis.passes import (  # noqa: F401
     excepts,
     lock_discipline,
     trace_purity,
+    span_discipline,
     collective_discipline,
     sharding_spec,
 )
 
 __all__ = ["atomic_writes", "metric_names", "fault_sites",
            "collective_instrumented", "bounded_retries", "excepts",
-           "lock_discipline", "trace_purity", "collective_discipline",
-           "sharding_spec"]
+           "lock_discipline", "trace_purity", "span_discipline",
+           "collective_discipline", "sharding_spec"]

@@ -1,0 +1,302 @@
+"""Rule ``span-discipline``: every tracer span opened with
+``start_trace`` / ``start_span`` must be closed on all paths.
+
+A span that is never ``end()``-ed sits in the tracer's live table
+forever: its trace never reaches the completed ring (the flight record
+silently loses exactly the request it was opened for) and the live
+table grows without bound — a leak the lock-discipline and bounded-ring
+guarantees cannot see.  The safe shapes, in preference order:
+
+- **context manager**: ``with tracer.trace(...)`` / ``tracer.span(...)``
+  (never start a span these fit), or ``with tracer.start_trace(...)``
+  — ``Span.__exit__`` ends on success *and* error paths;
+- **chained end**: ``tracer.start_trace(...).end(now)`` (also through
+  ``.set_attribute(...)``-style chains) — zero-width or retroactive
+  spans;
+- **ownership transfer**: the span is stored on an object
+  (``req._span = ...``), put in a container, passed to a callee or
+  returned — some other lifecycle owns the close (the tracer's
+  root-end force-close is the final backstop);
+- **explicit end on every path**: a local span whose every function
+  exit — fallthrough, ``return``, branch — is preceded by ``.end()``.
+
+Flagged:
+
+- a start call whose result is **discarded** (bare expression
+  statement, no chained ``.end``) — the span can never be ended;
+- a local span variable that is **never ended** (no ``.end()``, no
+  ``with``, no escape) anywhere in the function;
+- a ``return`` (or fallthrough) reachable with the span still
+  **open** — the paths-analysis is a statement-level walk: branches
+  must all close, ``try`` bodies may close in ``finally``, loops are
+  credited optimistically.
+
+The analysis is per-function and intentionally optimistic about
+escapes (a span passed to any call is assumed handed off), so every
+finding is near-certainly real.  Suppress a vetted site with
+``# lint-ok: span-discipline <reason>`` on the start line.
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import Finding, register
+
+RULE = "span-discipline"
+
+_START_ATTRS = {"start_trace", "start_span"}
+
+
+def _is_start_call(node):
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _START_ATTRS)
+
+
+def _chain_root(node):
+    """The head of an attribute/call chain: for
+    ``a.b(...).c(...).end()`` → the ``a`` Name (or the innermost
+    start-call for chains rooted at one)."""
+    while True:
+        if isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Attribute):
+            node = node.value
+        else:
+            return node
+
+
+def _parent_map(fn_node):
+    parents = {}
+    for node in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _chained_to_end(call, parents):
+    """Is ``call`` the root of a chain whose outermost call is
+    ``.end(...)``?  Covers ``start_trace(...).end()`` and
+    ``start_trace(...).set_attribute(...).end()``-style chains
+    (mutators return the span)."""
+    node = call
+    while True:
+        parent = parents.get(node)
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            call_parent = parents.get(parent)
+            if isinstance(call_parent, ast.Call) and \
+                    call_parent.func is parent:
+                if parent.attr == "end":
+                    return True
+                node = call_parent      # chained mutator; keep climbing
+                continue
+        return False
+
+
+def _name_refs(node, name):
+    return any(isinstance(sub, ast.Name) and sub.id == name
+               for sub in ast.walk(node))
+
+
+def _own_nodes(fn_node):
+    """Nodes of this function's own body — nested function/lambda
+    bodies are their own analysis units."""
+    nested = set()
+    for sub in ast.walk(fn_node):
+        if sub is fn_node:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            nested.update(ast.walk(sub))
+            nested.discard(sub)
+    return [n for n in ast.walk(fn_node) if n not in nested]
+
+
+class _SpanPaths:
+    """Statement-level all-paths walk for ONE named local span."""
+
+    def __init__(self, name, open_line, mod, fn_name, nested):
+        self.name = name
+        self.open_line = open_line
+        self.mod = mod
+        self.fn_name = fn_name
+        self.nested = nested
+        self.findings = []
+
+    def _flag(self, line, what):
+        self.findings.append(Finding(
+            self.mod.rel, self.open_line, RULE,
+            f"span '{self.name}' opened here can leave "
+            f"{self.fn_name}() un-ended: {what} (line {line})"))
+
+    def _closes(self, stmt):
+        """Does this statement (own nodes only) surely end or hand off
+        the span?  end()-chain, ``with name``, bare-name call argument,
+        return/yield of the name, store into attribute/subscript/
+        container, or deletion."""
+        for sub in ast.walk(stmt):
+            if sub in self.nested:
+                continue
+            if isinstance(sub, ast.Call):
+                root = _chain_root(sub)
+                if isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "end" and \
+                        isinstance(root, ast.Name) and \
+                        root.id == self.name:
+                    return True
+                for a in list(sub.args) + [kw.value
+                                           for kw in sub.keywords]:
+                    if isinstance(a, ast.Name) and a.id == self.name:
+                        return True     # handed to a callee
+            elif isinstance(sub, ast.withitem):
+                ce = sub.context_expr
+                if isinstance(ce, ast.Name) and ce.id == self.name:
+                    return True
+            elif isinstance(sub, (ast.Return, ast.Yield)):
+                if sub.value is not None and \
+                        _name_refs(sub.value, self.name):
+                    return True
+            elif isinstance(sub, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript,
+                                      ast.Tuple, ast.List))
+                       for t in sub.targets) and \
+                        _name_refs(sub.value, self.name):
+                    return True
+            elif isinstance(sub, (ast.List, ast.Tuple, ast.Dict,
+                                  ast.Set)):
+                if _name_refs(sub, self.name):
+                    return True         # packed into a container
+        return False
+
+    def _opens(self, stmt):
+        if isinstance(stmt, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == self.name
+                   for t in stmt.targets):
+                return any(_is_start_call(sub)
+                           for sub in ast.walk(stmt.value))
+        return False
+
+    @staticmethod
+    def _merge(statuses):
+        live = [s for s in statuses if s != "terminated"]
+        if not live:
+            return "terminated"
+        if any(s == "open" for s in live):
+            return "open"
+        if any(s == "closed" for s in live):
+            return "closed"
+        return "inactive"
+
+    def walk(self, stmts, status):
+        for stmt in stmts:
+            if status == "terminated":
+                return status           # rest of block unreachable
+            if self._opens(stmt):
+                # re-open (the name is rebound): a still-open previous
+                # span was already flagged when its path escaped
+                status = "open"
+                continue
+            if status == "open" and self._closes(stmt):
+                status = "closed"
+                continue
+            if isinstance(stmt, ast.Return):
+                if status == "open":
+                    self._flag(stmt.lineno, "return with span open")
+                return "terminated"
+            if isinstance(stmt, ast.Raise):
+                # optimistic: an uncaught raise leaks the span only if
+                # no outer finally/root-end catches it — too noisy to
+                # flag; try/finally shapes are credited explicitly
+                return "terminated"
+            if isinstance(stmt, ast.If):
+                s1 = self.walk(stmt.body, status)
+                s2 = self.walk(stmt.orelse, status)
+                status = self._merge([s1, s2])
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                s1 = self.walk(stmt.body, status)
+                self.walk(stmt.orelse, s1)
+                # optimistic: a loop that closes is credited even
+                # though it may run zero times — near-zero noise beats
+                # exhaustive zero-trip pessimism
+                status = s1 if s1 == "closed" else status
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                status = self.walk(stmt.body, status)
+            elif isinstance(stmt, ast.Try):
+                s_body = self.walk(stmt.body, status)
+                handler_in = (s_body
+                              if s_body not in ("inactive", "terminated")
+                              else status)
+                for h in stmt.handlers:
+                    self.walk(h.body, handler_in)
+                s_else = self.walk(stmt.orelse, s_body)
+                if stmt.finalbody:
+                    # finally runs even on return/raise out of the body
+                    fin_in = (s_else if s_else != "terminated"
+                              else handler_in)
+                    status = self.walk(stmt.finalbody, fin_in)
+                else:
+                    status = s_else
+        return status
+
+
+def _analyze_function(mod, fn_node, fn_name):
+    findings = []
+    own = _own_nodes(fn_node)
+    own_set = set(own)
+    nested = {n for n in ast.walk(fn_node) if n not in own_set}
+    parents = _parent_map(fn_node)
+    tracked = {}        # local name -> first-open line
+    for node in own:
+        if not _is_start_call(node):
+            continue
+        if _chained_to_end(node, parents):
+            continue
+        parent = parents.get(node)
+        # climb pure-expression wrappers (IfExp, BoolOp) to the
+        # statement/binding that consumes the span
+        consumer = parent
+        while isinstance(consumer, (ast.IfExp, ast.BoolOp,
+                                    ast.NamedExpr)):
+            consumer = parents.get(consumer)
+        if isinstance(consumer, ast.withitem):
+            continue                    # with ...start_trace(...):
+        if isinstance(consumer, ast.Call):
+            continue                    # argument: handed off at birth
+        if isinstance(consumer, (ast.Return, ast.Yield)):
+            continue                    # caller owns it
+        if isinstance(consumer, ast.Assign):
+            targets = consumer.targets
+            if len(targets) == 1 and isinstance(targets[0], ast.Name):
+                tracked.setdefault(targets[0].id, node.lineno)
+                continue
+            continue                    # attribute/subscript/tuple store
+        if isinstance(consumer, ast.Expr):
+            findings.append(Finding(
+                mod.rel, node.lineno, RULE,
+                f"span result of .{node.func.attr}(...) discarded in "
+                f"{fn_name}() — it can never be end()-ed"))
+            continue
+        # anything else (comparison, f-string, ...) — treat as a
+        # handoff; exotic reads don't leak more than the paths walk
+        # below would already catch for locals
+    for name, line in sorted(tracked.items(), key=lambda kv: kv[1]):
+        walker = _SpanPaths(name, line, mod, fn_name, nested)
+        final = walker.walk(fn_node.body, "inactive")
+        if final == "open":
+            walker._flag(fn_node.body[-1].lineno,
+                         "fallthrough with span open")
+        findings.extend(walker.findings)
+    return findings
+
+
+@register(RULE, "tracer spans ended on all paths")
+def find(project):
+    out = []
+    for mod in project.scoped_modules():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_analyze_function(mod, node, node.name))
+    out.sort(key=lambda f: (f.file, f.line))
+    return out
