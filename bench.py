@@ -614,13 +614,23 @@ def bench_fleet(n_requests=30, rate_per_s=12.0, max_new=16, n_replicas=3,
       untouched requests (the latency price of exactly-once recovery);
     - ``lost_requests`` — requests not FINISHED at trace end.  The
       zero-loss contract: this MUST be 0.
+
+    A second sub-scenario (``poison_storm`` in the payload) drives the
+    blast-radius containment machinery: 3 query-of-death requests into
+    a fresh 3-replica fleet (cascade breaker K=2, autoscaler attached
+    for zero-capacity recovery), asserting every poison ends terminal
+    QUARANTINED, uncontrolled replica kills stay <= K+1, and every
+    innocent finishes token-identical to a poison-free replay.
     """
     import dataclasses
 
     import jax
 
     from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
-    from paddle_tpu.serving import Engine, FleetRouter, SamplingParams
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.resilience import FaultSpec, injected_faults
+    from paddle_tpu.serving import (Autoscaler, Engine, FleetRouter,
+                                    SamplingParams)
 
     on_tpu = jax.devices()[0].platform not in ("cpu", "gpu", "cuda")
     name = "gpt2-small" if on_tpu else "tiny"
@@ -727,6 +737,82 @@ def bench_fleet(n_requests=30, rate_per_s=12.0, max_new=16, n_replicas=3,
         f"{out['redispatched_requests']} redispatched; TTFT p95 "
         f"{out['ttft_p95_ms_clean'] or 0:.0f}ms clean vs "
         f"{out['ttft_p95_ms_failover'] or 0:.0f}ms failover")
+
+    # ---- poison-storm containment sub-scenario --------------------------
+    pattern = (7, 8, 9)
+    n_innocent = max(8, n_requests // 3)
+    innocent_prompts = [rng.randint(0, cfg.vocab_size,
+                                    rng.randint(8, max_prompt)).tolist()
+                        for _ in range(n_innocent)]
+    storm_sp = SamplingParams(max_new_tokens=max_new)
+    # the poison-free oracle: one clean engine, batch-composition-
+    # independent greedy decode — what every innocent must emit
+    refs = factory().generate(innocent_prompts, storm_sp)
+    log(f"[fleet] poison storm: 3 poisons (pattern {list(pattern)}) "
+        f"into a fresh {n_replicas}-replica fleet, K=2, "
+        f"{n_innocent} innocents")
+    wd_prev, wd.enabled = wd.enabled, False
+    try:
+        registry = MetricsRegistry()
+        storm_router = FleetRouter(
+            [factory] * n_replicas, registry=registry,
+            stall_timeout_s=5.0, drain_deadline_s=0.5,
+            canary_threshold=2, cascade_threshold=2,
+            cascade_window_s=2.0,
+            warmup=lambda eng: eng.generate([[1, 2, 3]], warm))
+        scaler = Autoscaler(
+            storm_router, factory, registry=registry,
+            min_replicas=1, max_replicas=n_replicas,
+            up_pressure_s=2.0, down_pressure_s=0.1,
+            scale_up_cooldown_s=0.5, scale_down_cooldown_s=5.0,
+            spawn_max_retries=2)
+        for rep in storm_router.replicas:
+            rep.engine.generate([[1, 2, 3]], warm)
+        with injected_faults(FaultSpec("serving.step", "poison_request",
+                                       pattern=pattern)):
+            storm_reqs = [storm_router.submit(p, storm_sp)
+                          for p in innocent_prompts[:n_innocent // 2]]
+            poisons = [storm_router.submit(list(pattern) + [10],
+                                           storm_sp) for _ in range(3)]
+            storm_reqs += [storm_router.submit(p, storm_sp)
+                           for p in innocent_prompts[n_innocent // 2:]]
+            t1 = time.perf_counter()
+            while storm_router.has_work():
+                storm_router.step()
+                scaler.tick()
+                if time.perf_counter() - t1 > 120.0:
+                    raise AssertionError(
+                        "poison storm did not settle in 120s")
+    finally:
+        wd.enabled = wd_prev
+    storm_snap = storm_router.metrics.snapshot()
+    storm_out = {
+        "poisons": len(poisons),
+        "quarantined": [r.state == "quarantined" for r in poisons],
+        "innocents": n_innocent,
+        "innocents_finished": sum(1 for r in storm_reqs
+                                  if r.state == "finished"),
+        "innocents_token_identical": sum(
+            1 for r, ref in zip(storm_reqs, refs) if r.output == ref),
+        "uncontrolled_replica_kills": storm_snap["failure_events"],
+        "canary_deaths": storm_snap["canary_deaths"],
+        "cascade_breaker_opens": storm_snap["cascade_breaker_opens"],
+        "lost_requests": int(storm_snap["lost"]),
+    }
+    out["poison_storm"] = storm_out
+    assert all(storm_out["quarantined"]), \
+        f"poisons not all quarantined: {[r.state for r in poisons]}"
+    assert storm_out["uncontrolled_replica_kills"] <= 3, \
+        f"blast radius exceeded K+1: {storm_out}"
+    assert storm_out["innocents_finished"] == n_innocent, storm_out
+    assert storm_out["innocents_token_identical"] == n_innocent, \
+        "innocent output diverged from the poison-free replay"
+    assert storm_out["lost_requests"] == 0, storm_out
+    log(f"[fleet] poison storm contained: 3/3 quarantined, "
+        f"{storm_out['uncontrolled_replica_kills']} uncontrolled kills "
+        f"(+{storm_out['canary_deaths']} canary), "
+        f"{storm_out['innocents_token_identical']}/{n_innocent} "
+        f"innocents token-identical, lost 0")
     return out
 
 

@@ -34,11 +34,13 @@ enforces that):
                 summary + newest records, in-flight collectives, and
                 the hang watchdog's last desync report / bundle paths
   ``/fleet``    the serving fleet router: per-replica state (breaker,
-                drain, backpressure window, live engine health, prefix-
-                cache state — hit/eviction counters, cached pages and
-                the gossiped radix-summary size steering cache-aware
-                dispatch) and the ``router_*`` counters — 404 when no
-                router is attached
+                drain, backpressure window, canary reservation, live
+                engine health, prefix-cache state — hit/eviction
+                counters, cached pages and the gossiped radix-summary
+                size steering cache-aware dispatch), the blast-radius
+                fold (``quarantined`` count, ``suspects``,
+                ``cascade_breaker_open``) and the ``router_*`` counters
+                — 404 when no router is attached
   ``/integrity``  the silent-corruption sentinel: fingerprint/replay
                 check counts, last cross-rank-verified step, active
                 divergence state and recent events — 404 when no
@@ -345,7 +347,10 @@ class TelemetryServer(ThreadingHTTPServer):
         serving leg: with a fleet router attached its
         ``fleet_health()`` is authoritative — 503 only when NO replica
         can admit (all breakers open or draining); one replica merely
-        shedding is soft backpressure, not an outage.  Otherwise an
+        shedding is soft backpressure, not an outage, and the cascade
+        breaker being open with admittable replicas left is likewise
+        soft (the payload carries ``cascade_breaker_open`` and the
+        ``quarantined`` count for supervisors that care).  Otherwise an
         attached engine's ``health()``, else the serving gauges in the
         registry.  Folded on top: the ``training_healthy`` gauge
         (HealthMonitor) and the hang-watchdog state (attached

@@ -218,9 +218,12 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 # States a request trace can end in that make it unconditionally worth
-# keeping: shed (rejected / retry_after), evicted, evacuated — the tail
-# the ring exists to preserve.
-_INTERESTING_STATES = ("rejected", "retry_after", "evicted", "evacuated")
+# keeping: shed (rejected / retry_after), evicted, evacuated, plus the
+# blast-radius terminals — failed (per-row isolation pinned an error on
+# the request) and quarantined (convicted poison) — the tail the ring
+# exists to preserve.
+_INTERESTING_STATES = ("rejected", "retry_after", "evicted", "evacuated",
+                       "failed", "quarantined")
 
 
 class TailRetention:

@@ -34,6 +34,21 @@ Fault kinds:
     of the file (for a directory, of a seed-chosen file under it) is
     flipped in place.  Nothing is raised: detection is the integrity
     sentinel's job (``resilience.integrity``), not the injector's.
+``poison_request``
+    The query-of-death fault: at a site passing ``tokens=`` (an
+    iterable of token-ID streams — the serving engine passes every
+    in-flight request's tokens at ``serving.step``), raise
+    :class:`PoisonRequestError` whenever any stream contains the
+    spec's ``pattern`` as a contiguous subsequence (seed-chosen when
+    unset).  Unlike every other kind it matches on *content*, not
+    occurrence: the same poisoned prompt keeps killing every replica
+    it is re-dispatched to, which is exactly the cascade the router's
+    suspect-tracker / canary / quarantine machinery must contain.
+    ``PoisonRequestError`` is deliberately an ``OSError``: from the
+    fleet router's point of view a poisoned request crashes its
+    replica the way a dead RPC peer does — attribution is the
+    *router's* job (suspicion points, canary dispatch), never the
+    dying engine's.
 
 Everything is **off by default**: with no injector installed,
 ``fault_point`` is a dict lookup and a return.  Installation is
@@ -69,9 +84,16 @@ import contextlib
 import os
 import time
 
-__all__ = ["SimulatedCrash", "FaultSpec", "FaultInjector", "fault_point",
+__all__ = ["SimulatedCrash", "PoisonRequestError", "FAULT_KINDS",
+           "FaultSpec", "FaultInjector", "fault_point",
            "install", "uninstall", "current_injector", "injected_faults",
            "install_from_env"]
+
+#: every fault kind a FaultSpec may carry — tools/analysis's
+#: fault-sites pass reads this tuple (by AST, not import) and requires
+#: each kind to be exercised by at least one test
+FAULT_KINDS = ("kill", "torn_write", "io_error", "stall", "bitflip",
+               "poison_request")
 
 
 class SimulatedCrash(BaseException):
@@ -86,18 +108,38 @@ class SimulatedCrash(BaseException):
         self.occurrence = occurrence
 
 
+class PoisonRequestError(OSError):
+    """A poison request killed the engine it was running on.
+
+    Deliberately an ``OSError``: the fleet router's failure path treats
+    it exactly like a crashed replica RPC, so attribution (suspicion
+    points keyed by prompt hash, canary dispatch, quarantine) stays
+    where the evidence is — above the replica that just died."""
+
+    def __init__(self, site, pattern, occurrence):
+        super().__init__(
+            f"poison request at fault site {site!r}: token pattern "
+            f"{tuple(pattern)!r} is aboard (occurrence {occurrence})")
+        self.site = site
+        self.pattern = tuple(pattern)
+        self.occurrence = occurrence
+
+
 class FaultSpec:
     """Fire ``kind`` at the ``occurrence``-th hit (1-based) of ``site``.
 
     ``torn_frac`` overrides the seed-derived truncation fraction for
     ``torn_write``; ``stall_s`` sets the ``stall`` duration; ``leaf``
     pins a ``bitflip`` to a named tree leaf and ``bit`` to an exact bit
-    index (both seed-chosen when unset)."""
+    index (both seed-chosen when unset).  ``pattern`` (a token-ID
+    tuple, seed-chosen when unset) is the ``poison_request`` trigger:
+    that kind ignores ``occurrence`` and fires at EVERY hit of the
+    site whose ``tokens=`` payload contains the pattern — a poisoned
+    prompt is poisonous on every replica it reaches."""
 
     def __init__(self, site, kind="kill", occurrence=1, torn_frac=None,
-                 stall_s=0.05, leaf=None, bit=None):
-        if kind not in ("kill", "torn_write", "io_error", "stall",
-                        "bitflip"):
+                 stall_s=0.05, leaf=None, bit=None, pattern=None):
+        if kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {kind!r}")
         self.site = site
         self.kind = kind
@@ -106,6 +148,8 @@ class FaultSpec:
         self.stall_s = stall_s
         self.leaf = leaf
         self.bit = bit
+        self.pattern = None if pattern is None else tuple(
+            int(t) for t in pattern)
 
     def __repr__(self):
         return (f"FaultSpec({self.site!r}, {self.kind!r}, "
@@ -205,11 +249,47 @@ class FaultInjector:
             f.seek(bit // 8)
             f.write(bytes([b[0] ^ (1 << (bit % 8))]))
 
-    def on_fault_point(self, site, path=None, tree=None, span=None):
+    def _poison_pattern(self, spec):
+        """The spec's trigger pattern, seed-chosen (and cached on the
+        spec) when the caller didn't pin one."""
+        if spec.pattern is None:
+            spec.pattern = tuple(
+                int(t) for t in self._rng.integers(1, 1 << 15, size=3))
+        return spec.pattern
+
+    @staticmethod
+    def _contains(stream, pattern):
+        """Contiguous-subsequence match of ``pattern`` in ``stream``."""
+        n, m = len(stream), len(pattern)
+        if m == 0 or n < m:
+            return False
+        first = pattern[0]
+        for i in range(n - m + 1):
+            if stream[i] == first and \
+                    tuple(stream[i:i + m]) == pattern:
+                return True
+        return False
+
+    def on_fault_point(self, site, path=None, tree=None, span=None,
+                       tokens=None):
         occ = self._hits.get(site, 0) + 1
         self._hits[site] = occ
+        # poison_request matches on CONTENT, not occurrence: the same
+        # poisoned token pattern fires at every hit of the site it is
+        # aboard — re-dispatching the request to a fresh replica
+        # re-arms the fault, which is the whole cascade
+        if tokens is not None:
+            for spec in self.specs:
+                if spec.site != site or spec.kind != "poison_request":
+                    continue
+                pattern = self._poison_pattern(spec)
+                if any(self._contains(list(stream), pattern)
+                       for stream in tokens):
+                    self._record(site, spec.kind, occ, span=span)
+                    raise PoisonRequestError(site, pattern, occ)
         for spec in self.specs:
-            if spec.site != site or spec.occurrence != occ:
+            if spec.site != site or spec.occurrence != occ \
+                    or spec.kind == "poison_request":
                 continue
             self._record(site, spec.kind, occ, span=span)
             if spec.kind == "kill":
@@ -261,15 +341,19 @@ def injected_faults(*specs, seed=0):
         uninstall()
 
 
-def fault_point(site, path=None, tree=None, span=None):
+def fault_point(site, path=None, tree=None, span=None, tokens=None):
     """Declare a named fault site.  No-op unless an injector is
     installed AND a spec matches this site at the current hit count.
     ``tree`` (a mutable ``{name: array}`` dict) exposes live state to
     the ``bitflip`` kind — the caller must write replaced leaves back.
-    ``span`` pins the fired-fault event to a specific span instead of
-    the thread's ambient :func:`active_span`."""
+    ``tokens`` (an iterable of token-ID streams) exposes in-flight
+    request content to the ``poison_request`` kind, which fires on a
+    pattern match at EVERY hit, not a counted occurrence.  ``span``
+    pins the fired-fault event to a specific span instead of the
+    thread's ambient :func:`active_span`."""
     if _injector is not None:
-        _injector.on_fault_point(site, path=path, tree=tree, span=span)
+        _injector.on_fault_point(site, path=path, tree=tree, span=span,
+                                 tokens=tokens)
 
 
 def install_from_env(var="PADDLE_TPU_FAULTS"):

@@ -126,7 +126,52 @@ robustness unit.  Semantics it guarantees:
 - **fleet health fold** — ``/healthz`` (with the router attached to
   the telemetry server) is 503 only when NO replica can admit: all
   breakers open or draining.  One shedding replica is soft
-  backpressure, not an outage.
+  backpressure, not an outage, and the cascade breaker being open
+  with admittable replicas left is likewise soft (the payload carries
+  ``cascade_breaker_open``, ``quarantined`` and ``suspects``).
+
+Blast-radius containment contract (:mod:`engine` + :mod:`router` —
+README "Serving fleet"): failures are attributed to the narrowest
+thing that caused them — a row, a request, a replica — and contained
+there.  Semantics it guarantees:
+
+- **per-row isolation (engine)** — a Python exception raised while
+  planning or committing one specific row (packing its chunk, mapping
+  its pages, sampling/committing its token) is pinned on that request:
+  terminal ``RequestState.FAILED``, pages freed, trace closed with the
+  error — the other rows in the batch and the engine itself sail on.
+  Only failures not attributable to a row (the jitted step itself, the
+  top-of-step fault site, OSError RPC edges) escalate to the router's
+  replica-failure path.
+- **suspicion by content (router)** — every request aboard a replica
+  at the moment of an *uncontrolled* failure earns one suspicion
+  point, keyed by prompt hash, per DISTINCT failure event: failover
+  re-dispatches and re-submitted retries accumulate instead of
+  resetting.  Finishing a run exonerates the prompt.
+- **canary trial** — a request with ``canary_threshold`` (default 2)
+  points is only ever dispatched ALONE, on an idle replica reserved
+  for it (``canary_for``); no innocent is ever co-batched with a
+  request on trial.  Killing the canary convicts it: terminal
+  ``FleetRequestState.QUARANTINED`` with evidence attached (suspicion,
+  failure-event ids, canary replica, error) — never re-dispatched.  A
+  canary death is *controlled*: the replica restarts from its factory
+  and is counted in ``router_canary_deaths_total``, not the failure
+  window — which is what bounds a K-threshold poison storm at ≤ K+1
+  uncontrolled replica kills.
+- **cascade breaker (fleet)** — ``cascade_threshold`` uncontrolled
+  failures inside ``cascade_window_s`` open the fleet breaker
+  (``router_cascade_breaker_open`` = 1, a ``router::cascade`` span
+  brackets the storm): every suspect with ≥ 1 point must pass a canary
+  trial before normal dispatch resumes for it, and the attached
+  autoscaler vetoes scale-up while the breaker is open (a poison storm
+  is failure churn, not load — spawning would feed it fresh victims;
+  zero-healthy recovery still scales).  The breaker closes when the
+  window empties and no suspects remain queued or on trial.
+- **innocents are never taxed** — a co-batched innocent rides the
+  ordinary exactly-once failover: re-dispatch replays ``prompt +
+  harvested tokens`` and host-side greedy sampling is batch-
+  composition-independent, so its output stays token-identical to a
+  poison-free run no matter how many neighbours get quarantined.
 
 Autoscaler contract (:mod:`autoscaler` — README "Elastic fleet"): an
 :class:`Autoscaler` attached to a router sizes the fleet from live
@@ -205,9 +250,12 @@ state, across processes and across failures.  Semantics it guarantees:
 Soak exit criteria (:mod:`soak`, ``bench.py --section soak`` and the
 compressed tier-1 variant): replaying a seeded diurnal/bursty trace
 (:mod:`traffic`) through the autoscaled fleet while the chaos timeline
-fires hard kills, admission stalls, poll stalls, and spawn I/O errors
-must end with ``lost_requests == 0``, bounded TTFT p99, at least one
-scale-up AND one scale-down recorded in ``/fleet``, and every chaos
+fires hard kills, admission stalls, poll stalls, spawn I/O errors,
+KV-page bitflips, and poison storms must end with ``lost_requests ==
+0`` (quarantined/row-failed requests are *contained and accounted*,
+not lost), bounded TTFT p99, at least one scale-up AND one scale-down
+recorded in ``/fleet``, every poison request terminal ``QUARANTINED``
+and visible on ``/fleet`` and the retained trace ring, and every chaos
 event visible as a ``soak::*`` record in ``/flight``.
 """
 from .engine import Engine, Request, RequestState, SamplingParams  # noqa: F401

@@ -33,6 +33,12 @@ elastic:
   direction (an up is never immediately undone — the classic flap —
   while an up right after a down stays fast, because under-capacity
   is the expensive failure mode).
+- **cascade-breaker coordination** — when the router's cascade breaker
+  is open (≥ K uncontrolled replica failures in its sliding window — a
+  poison storm), every scale-up trigger except zero-healthy recovery
+  is vetoed: the pending backlog is failure churn, not demand, and a
+  spawn would only hand the poison a fresh victim.  A genuine load
+  burst arriving mid-storm still scales once the breaker closes.
 - **scale-up = spawn through the router's factory path** — a DEAD
   restartable replica is revived first (the cheapest capacity); else
   a fresh replica is appended via :meth:`~.router.FleetRouter.add_replica`.
@@ -201,6 +207,12 @@ class Autoscaler:
                 warming.append(rid)
         ready = max(0, healthy - len(warming))
         pending = self.router.pending_depth()
+        # the router's cascade breaker: >= K uncontrolled replica
+        # failures in the sliding window means the backlog is a poison
+        # storm churning the fleet, not organic load — scale-up on it
+        # would spawn fresh victims
+        cascade = bool(getattr(self.router, "cascade_open",
+                               lambda: False)())
         counters = self._router_counters()
         base = self._counter_base or counters
         self._counter_base = counters
@@ -225,6 +237,7 @@ class Autoscaler:
             "shed_delta": shed_delta,
             "goodput_ratio": goodput,
             "pressure_s": pressure,
+            "cascade_open": cascade,
             "time": now,
         }
 
@@ -249,8 +262,16 @@ class Autoscaler:
                         or now - last_any >= self.scale_down_cooldown_s))
         if healthy == 0 and self.max_replicas > 0:
             # nobody can absorb anything — bypass the up cooldown, this
-            # is recovery, not flap (every replica dead or draining)
+            # is recovery, not flap (every replica dead or draining).
+            # The cascade breaker does NOT veto this one: with zero
+            # healthy replicas even the canary trials are starved.
             return ("up", "no_capacity")
+        if sig.get("cascade_open"):
+            # poison storm in progress: the pending depth and shed rate
+            # are failure churn, not demand — adding replicas only
+            # feeds the cascade fresh victims.  A real load burst that
+            # arrives meanwhile still scales once the breaker closes.
+            return None
         if up_ok:
             if sig["pressure_s"] > self.up_pressure_s:
                 return ("up", "pressure")

@@ -112,6 +112,9 @@ class RequestState:
     EVICTED = "evicted"        # deadline/TTL passed before completion
     EVACUATED = "evacuated"    # pulled off a failed/draining replica; the
     #                            fleet router re-enqueues it elsewhere
+    FAILED = "failed"          # a row-attributable exception: THIS request
+    #                            broke, its pages are freed, the engine
+    #                            (and every co-batched request) lives on
 
 
 @dataclasses.dataclass
@@ -479,6 +482,27 @@ class Engine:
         self._end_trace(req, end_s=now)
         self._just_finished.append(req)
 
+    def _fail(self, req, exc):
+        """Per-row failure isolation: an exception raised while packing
+        or committing ONE row is that request's fault, not the
+        engine's — the row is retired terminal FAILED with its pages
+        freed and its trace closed on the error, and every co-batched
+        request keeps running.  Only exceptions that cannot be pinned
+        to a row (the jitted step itself, the top-of-step fault site)
+        escalate to the caller — the fleet router's replica-failure
+        path."""
+        if req in self._slots:
+            self.cache.free(req.id)
+            self._slots[self._slots.index(req)] = None
+        req.state = RequestState.FAILED
+        req.finish_reason = f"row failure: {exc!r}"
+        req.t_finished = self._clock()
+        self.metrics.requests_failed.inc()
+        if req._span is not None:
+            req._span.set_attribute("error", repr(exc))
+        self._end_trace(req, end_s=req.t_finished)
+        self._just_finished.append(req)
+
     def _evict_expired(self):
         """Evict every request (running OR still queued) whose deadline
         has passed — run at step start so freed pages are available to
@@ -637,19 +661,28 @@ class Engine:
             q = plan.get(i, 0)
             if req is None or q <= 0:
                 continue
-            if req.prompt_pos < len(req.prompt):
-                chunk = req.prompt[req.prompt_pos:req.prompt_pos + q]
-                ctx = req.prompt_pos + q
-            else:
-                chunk = req.tokens[-1:]
-                ctx = len(req.tokens)
+            try:
+                if req.prompt_pos < len(req.prompt):
+                    chunk = req.prompt[req.prompt_pos:req.prompt_pos + q]
+                    ctx = req.prompt_pos + q
+                else:
+                    chunk = req.tokens[-1:]
+                    ctx = len(req.tokens)
+                table = self.cache.page_table(req.id)
+            except Exception as e:
+                # row-attributable plan failure: THIS row dies, the
+                # batch (arrays untouched for it) runs without it
+                self._fail(req, e)
+                continue
             tokens[off:off + q] = chunk
             rows[off:off + q] = i
             slots[off:off + q] = np.arange(q)
             qlens[i], ctxs[i] = q, ctx
-            tables[i] = self.cache.page_table(req.id)
+            tables[i] = table
             sched.append((i, req, q, ctx))
             off += q
+        if not sched:
+            return
         t0 = self._clock()
         with RecordEvent("serving::unified_step"):
             logits, k, v = self._step_fn(
@@ -665,56 +698,62 @@ class Engine:
         n_rows = len(sched)
         sampled = 0
         for i, req, q, ctx in sched:
-            mid_prefill = req.prompt_pos < len(req.prompt)
-            if mid_prefill:
-                req.prompt_pos = ctx
-                self.metrics.prefill_tokens.inc(q)
-                self.metrics.prefill_chunks.inc()
-                if req._span is not None:
-                    self.tracer.start_span(
-                        f"chunk[{req._chunks_done}]", req._span,
-                        start_s=t0,
-                        attributes={"tokens": q, "prefilled": ctx,
-                                    "batch_slot": i,
-                                    "batch_size": n_rows,
-                                    "page_occupancy": occ}).end(t1)
-                req._chunks_done += 1
-                if ctx < len(req.prompt):
-                    continue                 # more chunks to go
-                # prompt complete: its FULL pages are now reusable K/V —
-                # register them in the radix tree so the next request
-                # sharing this prefix skips the prefill FLOPs (the
-                # partial final page keeps taking decode writes and is
-                # never shared)
-                if self.prefix_cache:
-                    self.cache.insert_prefix(req.id, req.prompt)
-                # the chunk that completed the prompt falls through and
-                # samples the request's first token — TTFT
-            tok = self._sample_token(logits[i], req)
-            req.tokens.append(tok)
-            sampled += 1
-            self.metrics.tokens_generated.inc()
-            if req.t_first_token is None:
-                # time-to-first-SAMPLED-token: stamped when the last
-                # prompt chunk completes, not when prefill starts
-                req.t_first_token = t1
-                # exemplar: this observation's trace — the /metrics
-                # p99 bucket then names a trace the ring retains
-                self.metrics.ttft.observe(
-                    t1 - req.t_submit,
-                    exemplar=getattr(req._span, "trace_id", None))
-            if not mid_prefill:
-                self.metrics.decode_token.observe(dt / n_rows)
-                if req._span is not None:
-                    # retroactive span over the batched step this
-                    # request rode in — one decode[i] per token
-                    self.tracer.start_span(
-                        f"decode[{len(req.output) - 1}]", req._span,
-                        start_s=t0,
-                        attributes={"batch_slot": i,
-                                    "batch_size": n_rows,
-                                    "page_occupancy": occ}).end(t1)
-            self._maybe_finish(req)
+            # per-row commit isolation: anything this row's sampling /
+            # bookkeeping raises is ITS failure — the row retires
+            # FAILED, every other row in the batch commits normally
+            try:
+                mid_prefill = req.prompt_pos < len(req.prompt)
+                if mid_prefill:
+                    req.prompt_pos = ctx
+                    self.metrics.prefill_tokens.inc(q)
+                    self.metrics.prefill_chunks.inc()
+                    if req._span is not None:
+                        self.tracer.start_span(
+                            f"chunk[{req._chunks_done}]", req._span,
+                            start_s=t0,
+                            attributes={"tokens": q, "prefilled": ctx,
+                                        "batch_slot": i,
+                                        "batch_size": n_rows,
+                                        "page_occupancy": occ}).end(t1)
+                    req._chunks_done += 1
+                    if ctx < len(req.prompt):
+                        continue             # more chunks to go
+                    # prompt complete: its FULL pages are now reusable
+                    # K/V — register them in the radix tree so the next
+                    # request sharing this prefix skips the prefill
+                    # FLOPs (the partial final page keeps taking decode
+                    # writes and is never shared)
+                    if self.prefix_cache:
+                        self.cache.insert_prefix(req.id, req.prompt)
+                    # the chunk that completed the prompt falls through
+                    # and samples the request's first token — TTFT
+                tok = self._sample_token(logits[i], req)
+                req.tokens.append(tok)
+                sampled += 1
+                self.metrics.tokens_generated.inc()
+                if req.t_first_token is None:
+                    # time-to-first-SAMPLED-token: stamped when the last
+                    # prompt chunk completes, not when prefill starts
+                    req.t_first_token = t1
+                    # exemplar: this observation's trace — the /metrics
+                    # p99 bucket then names a trace the ring retains
+                    self.metrics.ttft.observe(
+                        t1 - req.t_submit,
+                        exemplar=getattr(req._span, "trace_id", None))
+                if not mid_prefill:
+                    self.metrics.decode_token.observe(dt / n_rows)
+                    if req._span is not None:
+                        # retroactive span over the batched step this
+                        # request rode in — one decode[i] per token
+                        self.tracer.start_span(
+                            f"decode[{len(req.output) - 1}]", req._span,
+                            start_s=t0,
+                            attributes={"batch_slot": i,
+                                        "batch_size": n_rows,
+                                        "page_occupancy": occ}).end(t1)
+                self._maybe_finish(req)
+            except Exception as e:
+                self._fail(req, e)
         if dt > 0 and sampled:
             # EWMA decode throughput feeds the drain/retry-after hint
             inst = sampled / dt
@@ -779,8 +818,18 @@ class Engine:
         evicted) this step."""
         # fault site: an io_error here is the whole step failing the way
         # a crashed replica's RPC would — before any request state
-        # mutates, so a router can re-dispatch losslessly
-        fault_point("serving.step")
+        # mutates, so a router can re-dispatch losslessly.  tree=
+        # exposes the live KV page pool to the bitflip kind (silent
+        # corruption of serving state) and tokens= exposes every
+        # in-flight request's stream to poison_request (the
+        # query-of-death: a seed-chosen pattern that kills whichever
+        # replica it is aboard — deliberately NOT row-attributable)
+        kv = {"k_pages": self.cache.k_pages, "v_pages": self.cache.v_pages}
+        fault_point("serving.step", tree=kv,
+                    tokens=[r.tokens for r in self._running()]
+                    + [r.tokens for r in self._queue])
+        self.cache.k_pages, self.cache.v_pages = kv["k_pages"], \
+            kv["v_pages"]
         self._evict_expired()
         self._try_admit()
         self._unified_step_once(self._ensure_capacity())

@@ -72,6 +72,11 @@ class ServingMetrics:
             "serving_deadline_evictions_total",
             help="requests evicted (mid-decode or queued) past their "
                  "deadline/TTL"))
+        self.requests_failed = add(Counter(
+            "serving_requests_failed_total",
+            help="requests retired FAILED by per-row exception "
+                 "isolation — the row broke, the engine (and every "
+                 "co-batched request) survived"))
         self.engine_healthy = add(Gauge(
             "serving_engine_healthy",
             help="1 = healthy (admitting), 0 = degraded (shedding)"))
@@ -123,6 +128,7 @@ class ServingMetrics:
                 "preempted": self.requests_preempted.value,
                 "shed": self.requests_shed.value,
                 "deadline_evicted": self.deadline_evictions.value,
+                "failed": self.requests_failed.value,
             },
             "engine_healthy": self.engine_healthy.value,
             "tokens": {
@@ -217,6 +223,39 @@ class RouterMetrics:
             "router_requests_lost_total",
             help="requests the router could not place or recover — "
                  "MUST stay 0; anything else is a failover bug"))
+        self.quarantined = add(Counter(
+            "router_requests_quarantined_total",
+            help="requests retired terminal QUARANTINED: suspected of "
+                 "poisoning replicas and convicted by killing a canary "
+                 "they ran on alone"))
+        self.canary_dispatches = add(Counter(
+            "router_canary_dispatches_total",
+            help="suspect requests admitted alone to a reserved canary "
+                 "replica (no co-batched innocents in the blast radius)"))
+        self.canary_deaths = add(Counter(
+            "router_canary_deaths_total",
+            help="canary replicas killed by the lone suspect aboard — "
+                 "each is a conviction, not a failover (the replica is "
+                 "rebuilt, the request is quarantined, nothing is "
+                 "re-dispatched)"))
+        self.failure_events = add(Counter(
+            "router_replica_failure_events_total",
+            help="uncontrolled replica failures (breaker-opening "
+                 "crashes/stalls/probe losses; canary deaths excluded) "
+                 "— the cascade breaker's sliding-window input"))
+        self.cascade_opens = add(Counter(
+            "router_cascade_breaker_opens_total",
+            help="times the fleet-wide cascade breaker opened "
+                 "(>= K uncontrolled replica failures in the window)"))
+        self.cascade_open = add(Gauge(
+            "router_cascade_breaker_open",
+            help="1 = cascade breaker open: suspected requests drain "
+                 "through canary-only dispatch and the autoscaler "
+                 "holds scale-up (poison is not load)"))
+        self.suspects = add(Gauge(
+            "router_suspected_requests",
+            help="prompt-hash keys currently holding >= 1 suspicion "
+                 "point (present at a replica failure)"))
         self.breaker_open = add(Gauge(
             "router_breaker_open", labelnames=("replica",),
             help="1 = circuit breaker open (replica out of rotation)"))
@@ -252,6 +291,13 @@ class RouterMetrics:
             "drains": self._family(self.drains),
             "restarts": self._family(self.restarts),
             "lost": self.lost.value,
+            "quarantined": self.quarantined.value,
+            "canary_dispatches": self.canary_dispatches.value,
+            "canary_deaths": self.canary_deaths.value,
+            "failure_events": self.failure_events.value,
+            "cascade_breaker_opens": self.cascade_opens.value,
+            "cascade_breaker_open": self.cascade_open.value,
+            "suspected_requests": self.suspects.value,
             "breaker_open": self._family(self.breaker_open),
             "replicas_admittable": self.replicas_admittable.value,
             "fleet_healthy": self.fleet_healthy.value,

@@ -45,6 +45,26 @@ machine-readable back-off hint — and this module is their consumer:
   after a *completed* step count as emitted, so nothing is delivered
   twice and greedy output stays token-identical to an un-failed run
   (the engine's own recompute-parity guarantee, lifted to the fleet).
+- **blast-radius containment** — replica failures are attributed to
+  *requests*, not just replicas.  Every request aboard at an
+  uncontrolled replica failure earns one suspicion point (keyed by
+  prompt hash, so failover re-dispatches and retries accumulate); a
+  request present at ≥ ``canary_threshold`` distinct failures is only
+  ever dispatched ALONE on a reserved *canary* replica, and killing
+  the canary too convicts it: terminal ``QUARANTINED`` with the
+  failure evidence attached, never re-dispatched.  Canary deaths are
+  controlled (the replica restarts from its factory; counted in
+  ``router_canary_deaths_total``, not the failure window).  A *cascade
+  breaker* opens at ≥ ``cascade_threshold`` uncontrolled failures
+  inside ``cascade_window_s``: every suspect (≥ 1 point) then goes
+  through canary trial before rejoining normal dispatch, a
+  ``router::cascade`` span brackets the storm, and the autoscaler
+  holds scale-up while the breaker is open (poison is not load).
+  Innocent co-batched requests keep the exactly-once token-identical
+  failover guarantee throughout — re-dispatch replays
+  ``prompt + harvested tokens`` and host-side greedy sampling is
+  batch-composition-independent, so a neighbour's quarantine never
+  perturbs their output.
 - **graceful drain / rolling restart** — :meth:`FleetRouter.drain`
   marks a replica draining: no new admissions, in-flight decode runs
   to completion bounded by a drain deadline, stragglers are
@@ -75,6 +95,7 @@ import time
 from collections import deque
 
 from ..observability.tracing import Tracer, activate, default_tracer
+from ..resilience.faults import fault_point
 from ..resilience.retry import backoff_delays
 from .engine import Engine, RequestState, SamplingParams
 from .kv_cache import prefix_hashes
@@ -98,6 +119,12 @@ class FleetRequestState:
     FINISHED = "finished"
     REJECTED = "rejected"      # infeasible on the replica that saw it
     EVICTED = "evicted"        # fleet-level TTL passed
+    FAILED = "failed"          # the replica's per-row isolation pinned an
+    #                            exception on THIS request (terminal)
+    QUARANTINED = "quarantined"  # convicted poison: suspected at >= 2
+    #                              replica failures, then killed the
+    #                              canary it ran on alone (terminal,
+    #                              evidence attached — never re-dispatched)
 
 
 @dataclasses.dataclass
@@ -124,9 +151,12 @@ class FleetRequest:
     t_first_token: float = None
     t_finished: float = None
     deadline: float = None       # router-clock absolute; None = no TTL
+    quarantine_evidence: dict = None   # set iff state == QUARANTINED
     _engine_req: object = None   # Request on the current replica
     _dispatch_base: int = 0      # len(tokens_out) when this dispatch began
     _span: object = None         # root trace span
+    _prompt_key: int = 0         # content hash — suspicion is keyed by
+    #                              prompt so retries/failovers accumulate
 
     @property
     def output(self):
@@ -149,6 +179,7 @@ class Replica:
         self.drain_deadline = None
         self.restart_after_drain = True
         self._drain_span = None
+        self.canary_for = None         # FleetRequest.id reserved alone here
 
     def __repr__(self):
         return (f"Replica({self.replica_id}, {self.state}, "
@@ -204,7 +235,9 @@ class FleetRouter:
                  stall_timeout_s=0.25, backoff_base_s=0.05,
                  backoff_cap_s=2.0, drain_deadline_s=5.0, warmup=None,
                  cache_aware=True, cache_hit_token_s=0.01,
-                 prefix_summary_source=None, rng=None):
+                 prefix_summary_source=None, rng=None,
+                 canary_threshold=2, cascade_threshold=3,
+                 cascade_window_s=10.0):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.warmup = warmup
@@ -229,6 +262,28 @@ class FleetRouter:
         self.cache_aware = bool(cache_aware)
         self.cache_hit_token_s = float(cache_hit_token_s)
         self._summary_source = prefix_summary_source
+        # blast-radius containment: a request in flight at a replica
+        # failure earns one suspicion point per DISTINCT failure event
+        # (keyed by prompt hash).  At ``canary_threshold`` points it is
+        # only ever dispatched alone, on a canary replica; killing the
+        # canary too is conviction -> terminal QUARANTINED.
+        # ``cascade_threshold`` uncontrolled replica failures inside
+        # ``cascade_window_s`` open the fleet cascade breaker: suspects
+        # (>=1 point) drain through canary mode only, and the attached
+        # autoscaler treats the storm as poison, not load.
+        self.canary_threshold = int(canary_threshold)
+        self.cascade_threshold = int(cascade_threshold)
+        self.cascade_window_s = float(cascade_window_s)
+        self._suspects = {}          # prompt_key -> set(failure event ids)
+        # prompt_key -> conviction evidence: the verdict OUTLIVES the
+        # convicted request, so a storm of requests all carrying the
+        # same poison content is quarantined at admission after the
+        # first conviction instead of serially re-killing canaries
+        self._convicted = {}
+        self._failure_seq = 0        # distinct uncontrolled failure events
+        self._failure_times = deque()  # their router-clock timestamps
+        self._cascade_open = False
+        self._cascade_span = None
         self._rng = rng or random
         self.replicas = []
         for item in replicas:
@@ -274,6 +329,10 @@ class FleetRouter:
         with self._lock:
             freq = FleetRequest(id=self._next_id, prompt=list(prompt),
                                 sampling=sampling, t_submit=now)
+            # suspicion is tracked by CONTENT, not request id: a poison
+            # prompt re-submitted (or failover re-dispatched) keeps
+            # accumulating points instead of starting innocent
+            freq._prompt_key = hash(tuple(freq.prompt))
             self._next_id += 1
             if sampling.ttl_s is not None:
                 # the fleet-level deadline: survives failover (the
@@ -310,6 +369,10 @@ class FleetRouter:
         with self._lock:
             table = self._assigned[rep.replica_id]
             self._harvest_table(table, finished)
+            if rep.canary_for is not None and rep.canary_for not in table:
+                # the canaried suspect reached a terminal state without
+                # killing its host: the reservation lifts
+                rep.canary_for = None
 
     def _harvest_table(self, table, finished):
         for freq in list(table.values()):
@@ -329,27 +392,50 @@ class FleetRouter:
                 self._finish(freq, FleetRequestState.FINISHED,
                              ereq.finish_reason)
                 self.metrics.finished.inc()
+                # completing normally exonerates the prompt: a suspect
+                # that survives a full run was collateral, not poison
+                self._suspects.pop(freq._prompt_key, None)
                 finished.append(freq)
             elif ereq.state == RequestState.EVICTED:
                 del table[freq.id]
                 self._finish(freq, FleetRequestState.EVICTED,
                              ereq.finish_reason)
+                self._suspects.pop(freq._prompt_key, None)
+                finished.append(freq)
+            elif ereq.state == RequestState.FAILED:
+                # the engine's per-row isolation pinned an exception on
+                # this specific request — terminal at fleet level too,
+                # never re-dispatched (the failure is deterministic to
+                # the row, not the replica)
+                del table[freq.id]
+                self._finish(freq, FleetRequestState.FAILED,
+                             ereq.finish_reason)
+                self._suspects.pop(freq._prompt_key, None)
                 finished.append(freq)
 
     # ------------------------------------------------------------ failure
-    def _reclaim(self, rep, reason="failover", exc=None):
+    def _reclaim(self, rep, reason="failover", exc=None,
+                 failure_event=None):
         """Pull every request assigned to ``rep`` back into the router
-        queue (front, original order), each exactly once.  Only tokens
-        harvested after a completed step ride along — the re-dispatch
-        admission is ``prompt + tokens_out``, so the next replica
-        rebuilds KV state from scratch and cannot double-emit.  Each
+        queue (front, original admission order), each exactly once.
+        Only tokens harvested after a completed step ride along — the
+        re-dispatch admission is ``prompt + tokens_out``, so the next
+        replica rebuilds KV state from scratch and cannot double-emit.
+        ``failure_event`` (a distinct uncontrolled-failure id) charges
+        every reclaimed request one suspicion point — all of them were
+        aboard when the replica died, and one of them may be why.  Each
         moved request gets a ``router::failover`` child span on ITS OWN
         fleet trace — the original trace continues through re-dispatch
         instead of being severed at the most interesting moment."""
         with self._lock:
             table = self._assigned[rep.replica_id]
-            moved = list(table.values())
+            # sort by request id (== admission order): the assignment
+            # table is keyed per-dispatch, so relying on dict insertion
+            # order would re-enqueue a mixed harvest (original + prior
+            # failovers) in arbitrary relative order
+            moved = sorted(table.values(), key=lambda f: f.id)
             table.clear()
+            rep.canary_for = None
             try:
                 # frees the abandoned engine's pages (and closes
                 # request traces) when it is still reachable; a
@@ -363,6 +449,9 @@ class FleetRouter:
                 freq.replica_id = None
                 freq._engine_req = None
                 freq.redispatches += 1
+                if failure_event is not None:
+                    self._suspects.setdefault(
+                        freq._prompt_key, set()).add(failure_event)
                 if freq._span is not None:
                     self.tracer.start_span(
                         "router::failover", freq._span, start_s=now,
@@ -379,12 +468,21 @@ class FleetRouter:
 
     def _on_replica_failure(self, rep, reason, exc=None):
         """Count a failure against ``rep``; at ``breaker_threshold``
-        open the breaker and fail everything over."""
+        open the breaker and fail everything over.  A canary replica
+        dying under its lone suspect is handled as a conviction
+        (quarantine + controlled restart) instead — it never feeds the
+        cascade window, because the blast was contained by design."""
         if rep.state == ReplicaState.DEAD:
             return
         rep.consecutive_failures += 1
         if rep.consecutive_failures < self.breaker_threshold:
             return
+        with self._lock:
+            if rep.canary_for is not None and \
+                    self._assigned[rep.replica_id]:
+                self._on_canary_death(rep, reason, exc)
+                return
+            rep.canary_for = None   # reservation died before admission
         if rep._drain_span is not None:      # failed mid-drain
             rep._drain_span.set_attributes({"failed": reason})
             rep._drain_span.end()
@@ -394,11 +492,117 @@ class FleetRouter:
         rid = str(rep.replica_id)
         self.metrics.breaker_open.labels(replica=rid).set(1)
         self.metrics.failovers.labels(replica=rid, reason=reason).inc()
+        # an UNCONTROLLED failure: distinct event id charges suspicion
+        # to everything aboard, its timestamp feeds the cascade window
+        now = self._clock()
+        with self._lock:
+            self._failure_seq += 1
+            event = self._failure_seq
+            self._failure_times.append(now)
+            self.metrics.failure_events.inc()
+            self._maybe_open_cascade_locked(now)
         # no standalone failover trace: the event lands as a
         # router::failover span on every affected request's own trace
         # (see _reclaim), so the timeline survives the re-dispatch
-        self._reclaim(rep, reason=reason, exc=exc)
+        self._reclaim(rep, reason=reason, exc=exc, failure_event=event)
         self._update_gauges()
+
+    def _on_canary_death(self, rep, reason, exc):
+        """The canary replica died while running its suspect ALONE —
+        conclusive guilt.  The suspect goes terminal ``QUARANTINED``
+        with the evidence attached (never re-dispatched), the canary is
+        rebuilt from its factory (a controlled death: counted in
+        ``canary_deaths``, not in the cascade window — the blast radius
+        was exactly one reserved replica).  Caller holds ``self._lock``."""
+        table = self._assigned[rep.replica_id]
+        victims = sorted(table.values(), key=lambda f: f.id)
+        table.clear()
+        rep.canary_for = None
+        try:
+            rep.engine.evacuate()
+        except Exception:
+            pass  # silent-ok: a hard-dead engine has nothing to free
+        self.metrics.canary_deaths.inc()
+        for freq in victims:
+            self._quarantine_locked(freq, rep, reason, exc)
+        if rep.factory is not None:
+            self._restart(rep)
+        else:
+            rep.state = ReplicaState.DEAD
+            self.metrics.breaker_open.labels(
+                replica=str(rep.replica_id)).set(1)
+        self._update_gauges()
+
+    def _quarantine_locked(self, freq, rep, reason, exc):
+        evidence = {
+            "suspicion": len(self._suspects.get(freq._prompt_key, ())),
+            "failure_events": sorted(
+                self._suspects.get(freq._prompt_key, ())),
+            "canary_replica": rep.replica_id,
+            "reason": reason,
+            "error": repr(exc) if exc is not None else None,
+        }
+        freq.quarantine_evidence = evidence
+        self._convicted[freq._prompt_key] = evidence
+        self._suspects.pop(freq._prompt_key, None)
+        if freq._span is not None:
+            self.tracer.start_span(
+                "router::quarantine", freq._span,
+                start_s=self._clock(),
+                attributes=dict(evidence)).end(self._clock())
+        self._finish(freq, FleetRequestState.QUARANTINED,
+                     f"poison request: killed canary replica "
+                     f"{rep.replica_id} ({reason})")
+        self.metrics.quarantined.inc()
+
+    # --------------------------------------------------- cascade breaker
+    def _trim_failure_window_locked(self, now):
+        cutoff = now - self.cascade_window_s
+        while self._failure_times and self._failure_times[0] <= cutoff:
+            self._failure_times.popleft()
+
+    def _maybe_open_cascade_locked(self, now):
+        self._trim_failure_window_locked(now)
+        if self._cascade_open or \
+                len(self._failure_times) < self.cascade_threshold:
+            return
+        self._cascade_open = True
+        self.metrics.cascade_opens.inc()
+        self.metrics.cascade_open.set(1)
+        self._cascade_span = self.tracer.start_trace(
+            "router::cascade", start_s=now,
+            attributes={"failures_in_window": len(self._failure_times),
+                        "threshold": self.cascade_threshold,
+                        "window_s": self.cascade_window_s})
+
+    def _maybe_close_cascade_locked(self, now):
+        if not self._cascade_open:
+            return
+        self._trim_failure_window_locked(now)
+        if self._failure_times:
+            return            # a failure is still inside the window
+        if any(rep.canary_for is not None for rep in self.replicas):
+            return            # a suspect is mid-trial on a canary
+        if any(self._suspicion_locked(f) > 0 for f in self._pending):
+            return            # suspects still queued for canary trial
+        self._cascade_open = False
+        self.metrics.cascade_open.set(0)
+        if self._cascade_span is not None:
+            self._cascade_span.set_attribute(
+                "quarantined_total", int(self.metrics.quarantined.value))
+            self._cascade_span.end(now)
+            self._cascade_span = None
+
+    def _suspicion_locked(self, freq):
+        return len(self._suspects.get(freq._prompt_key, ()))
+
+    def cascade_open(self):
+        """Whether the fleet cascade breaker is open (>= K uncontrolled
+        replica failures inside the sliding window; suspects draining
+        through canary mode).  The autoscaler reads this to keep a
+        poison storm from masquerading as load."""
+        with self._lock:
+            return self._cascade_open
 
     # ---------------------------------------------------- prefix gossip
     def _refresh_prefix_summaries(self):
@@ -455,7 +659,22 @@ class FleetRouter:
 
     # -------------------------------------------------------------- admit
     def _can_admit(self, rep, now):
-        return rep.state == ReplicaState.HEALTHY and now >= rep.not_before
+        # a replica reserved as a canary admits ONLY its suspect: no
+        # innocent may be co-batched with a request on trial
+        return (rep.state == ReplicaState.HEALTHY
+                and now >= rep.not_before
+                and rep.canary_for is None)
+
+    def _pick_canary_locked(self, now):
+        """An idle healthy replica to run a suspect ALONE on — nothing
+        assigned, no reservation, admission window open.  Lowest id
+        wins (determinism)."""
+        cands = [rep for rep in self.replicas
+                 if rep.state == ReplicaState.HEALTHY
+                 and rep.canary_for is None
+                 and now >= rep.not_before
+                 and not self._assigned[rep.replica_id]]
+        return min(cands, key=lambda r: r.replica_id) if cands else None
 
     def _backpressure(self, rep, hint_s, now):
         """RETRY_AFTER from ``rep``: close its admission window for
@@ -472,7 +691,8 @@ class FleetRouter:
             replica=str(rep.replica_id)).inc()
         return delay
 
-    def _dispatch_locked(self, freq, rep, now, expected_hit=0):
+    def _dispatch_locked(self, freq, rep, now, expected_hit=0,
+                         canary=False):
         """Try the queue-head request on ``rep`` (caller holds
         ``self._lock`` — the ``_admit`` loop owns the queue while it
         places work).  ``expected_hit`` is the gossip-predicted prefix
@@ -498,6 +718,8 @@ class FleetRouter:
         dattrs = {"request_id": freq.id, "replica": rep.replica_id,
                   "expected_prefix_hit_tokens": expected_hit,
                   "redispatch": freq.redispatches > 0}
+        if canary:
+            dattrs["canary"] = True
         if freq._span is not None:
             dspan = self.tracer.start_span("router::dispatch", freq._span,
                                            start_s=now, attributes=dattrs)
@@ -552,6 +774,37 @@ class FleetRouter:
             self._on_replica_failure(rep, "stall")
         return "dispatched"
 
+    def _canary_dispatch_locked(self, head, now, suspicion, skip):
+        """Route the queue-head suspect to a canary: an idle healthy
+        replica reserved for it ALONE.  Returns ``"wait"`` when no
+        replica is free to canary on (the head blocks; in-flight work
+        keeps completing elsewhere, so a replica frees up next ticks),
+        otherwise the ``_dispatch_locked`` status.  Caller holds
+        ``self._lock``."""
+        rep = self._pick_canary_locked(now)
+        if rep is None or rep.replica_id in skip:
+            return "wait"
+        try:
+            # the canary-dispatch RPC edge: an injected io_error here
+            # is a transient dispatch failure — the suspect stays at
+            # the queue head and the trial retries next tick
+            fault_point("router.canary_dispatch")
+        except OSError:
+            return "wait"
+        rep.canary_for = head.id
+        self.metrics.canary_dispatches.inc()
+        if head._span is not None:
+            self.tracer.start_span(
+                "router::canary", head._span, start_s=now,
+                attributes={"replica": rep.replica_id,
+                            "suspicion": suspicion}).end(now)
+        status = self._dispatch_locked(head, rep, now, canary=True)
+        if status != "dispatched":
+            rep.canary_for = None
+        if status in ("backpressure", "failed"):
+            skip.add(rep.replica_id)
+        return status
+
     def _admit(self, now):
         """Place queued requests on the best admittable replica.  The
         score is the drain estimate MINUS the expected prefix-cache
@@ -564,6 +817,37 @@ class FleetRouter:
         with self._lock:
             while self._pending:
                 head = self._pending[0]
+                verdict = self._convicted.get(head._prompt_key)
+                if verdict is not None:
+                    # identical content to an already-convicted poison:
+                    # the kill is deterministic, so skip the canary and
+                    # quarantine on the sibling's evidence
+                    self._pending.popleft()
+                    head.quarantine_evidence = dict(
+                        verdict, convicted_sibling=True)
+                    if head._span is not None:
+                        self.tracer.start_span(
+                            "router::quarantine", head._span,
+                            start_s=now,
+                            attributes=dict(
+                                head.quarantine_evidence)).end(now)
+                    self._finish(
+                        head, FleetRequestState.QUARANTINED,
+                        "poison request: prompt content already "
+                        "convicted")
+                    self.metrics.quarantined.inc()
+                    continue
+                suspicion = self._suspicion_locked(head)
+                if suspicion >= self.canary_threshold or \
+                        (self._cascade_open and suspicion >= 1):
+                    # suspect: canary trial only — alone, on a reserved
+                    # replica, so a kill convicts exactly one request
+                    # and co-batched innocents don't exist to lose
+                    status = self._canary_dispatch_locked(
+                        head, now, suspicion, skip)
+                    if status == "wait":
+                        break       # no idle replica to canary on yet
+                    continue
                 admission_tokens = head.prompt + head.tokens_out
                 hash_cache = {}    # page_size -> prefix hash chain
                 cands = []
@@ -759,6 +1043,11 @@ class FleetRouter:
             # against each target replica's current tree
             self._refresh_prefix_summaries()
         self._admit(now)
+        with self._lock:
+            # re-read the clock: a poison trial earlier in this tick
+            # may have burned real window time (canary restart)
+            self._maybe_close_cascade_locked(self._clock())
+            self.metrics.suspects.set(len(self._suspects))
         self._update_gauges()
         return finished
 
@@ -838,10 +1127,16 @@ class FleetRouter:
                 }
             admittable = sum(1 for rep in self.replicas
                              if rep.state == ReplicaState.HEALTHY)
+            # the cascade breaker being open is SOFT while any replica
+            # can still admit: suspects drain through canary trials and
+            # innocents keep flowing, so /healthz must not 503
             return {"healthy": admittable > 0,
                     "replicas_admittable": admittable,
                     "replicas_total": len(self.replicas),
                     "pending": len(self._pending),
+                    "quarantined": int(self.metrics.quarantined.value),
+                    "suspects": len(self._suspects),
+                    "cascade_breaker_open": self._cascade_open,
                     "replicas": per}
 
     def fleet_status(self):
@@ -861,6 +1156,7 @@ class FleetRouter:
                                               rep.not_before - now),
                     "in_flight": len(self._assigned[rep.replica_id]),
                     "restartable": rep.factory is not None,
+                    "canary_for": rep.canary_for,
                 }
                 if rep.drain_deadline is not None:
                     entry["drain_deadline_in_s"] = \

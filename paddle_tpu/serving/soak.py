@@ -26,10 +26,18 @@ SIGKILL emulation), ``stall_admit``/``stall_poll`` arm a one-shot
 ``stall`` at the ``serving.admit`` / ``autoscaler.poll`` fault sites,
 ``spawn_io_error`` arms a one-shot ``io_error`` at
 ``autoscaler.scale_up`` (the next spawn attempt dies and is retried
-out of the bounded backoff budget).  Arming appends a
+out of the bounded backoff budget), ``bitflip`` arms a one-shot
+seeded bit flip in a live KV page at ``serving.step`` (silent state
+corruption: at worst one request's output degrades — the fleet must
+not notice), and ``poison_storm`` arms a content-matched
+``poison_request`` spec (``ev.pattern``) and submits ``ev.count``
+requests CARRYING that pattern — every replica they board dies, and
+the run asserts the router's blast-radius containment quarantines
+them while innocents keep the zero-loss guarantee.  Arming appends a
 ``FaultSpec(site, kind, occurrence=hits+1)`` to the installed
-injector, so each event fires exactly once at the next hit — fully
-deterministic, fully audited (``report["injector_fired"]``).
+injector (the poison spec is content-matched instead — it fires on
+every step whose batch carries the pattern), so each event fires
+deterministically and fully audited (``report["injector_fired"]``).
 """
 from __future__ import annotations
 
@@ -57,12 +65,20 @@ class ChaosEvent:
     run start), do ``action`` — one of ``kill`` (hard replica death),
     ``stall_admit`` / ``stall_poll`` (one-shot stall at the
     ``serving.admit`` / ``autoscaler.poll`` site, ``stall_s`` long),
-    ``spawn_io_error`` (one-shot OSError at ``autoscaler.scale_up``).
-    ``fired``/``detail`` are filled in by the run."""
+    ``spawn_io_error`` (one-shot OSError at ``autoscaler.scale_up``),
+    ``bitflip`` (one-shot KV-page bit flip at ``serving.step`` —
+    silent live-state corruption), ``poison_storm`` (arm a
+    ``poison_request`` spec matching ``pattern`` and submit ``count``
+    poison requests carrying it; their FleetRequest ids land in
+    ``detail["request_ids"]``).  ``fired``/``detail`` are filled in by
+    the run."""
 
     t: float
     action: str
     stall_s: float = 0.3
+    pattern: tuple = None        # poison_storm: the token-ID pattern
+    count: int = 3               # poison_storm: poison requests to send
+    max_new_tokens: int = 8      # poison_storm: their decode budget
     fired: bool = False
     detail: object = None
 
@@ -80,9 +96,11 @@ def _get_json(url, timeout=5.0):
         return json.loads(resp.read().decode())
 
 
-def _fire_chaos(ev, router, inj, flight, log):
+def _fire_chaos(ev, router, inj, flight, log, reqs):
     """Apply one due chaos event; every action leaves a flight-recorder
-    record so ``/flight`` shows the full chaos timeline."""
+    record so ``/flight`` shows the full chaos timeline.  Actions that
+    submit traffic (``poison_storm``) append their FleetRequests to
+    ``reqs`` so the run's accounting covers them."""
     detail = None
     if ev.action == "kill":
         victim = next((rep for rep in router.replicas
@@ -109,6 +127,27 @@ def _fire_chaos(ev, router, inj, flight, log):
             "autoscaler.scale_up", "io_error",
             occurrence=inj.hits("autoscaler.scale_up") + 1))
         detail = {"site": "autoscaler.scale_up"}
+    elif ev.action == "bitflip":
+        # one seeded bit flip in a live KV page on the next step: the
+        # blast radius is at most the request whose page corrupted —
+        # the fleet must sail on (no replica failure, no cascade)
+        inj.specs.append(FaultSpec(
+            "serving.step", "bitflip",
+            occurrence=inj.hits("serving.step") + 1))
+        detail = {"site": "serving.step"}
+    elif ev.action == "poison_storm":
+        if not ev.pattern:
+            raise ValueError("poison_storm needs a token-ID pattern")
+        pattern = tuple(int(t) for t in ev.pattern)
+        inj.specs.append(FaultSpec(
+            "serving.step", "poison_request", pattern=pattern))
+        storm = [router.submit(list(pattern),
+                               SamplingParams(
+                                   max_new_tokens=ev.max_new_tokens))
+                 for _ in range(int(ev.count))]
+        reqs.extend(storm)
+        detail = {"site": "serving.step", "pattern": list(pattern),
+                  "request_ids": [r.id for r in storm]}
     else:
         raise ValueError(f"unknown chaos action {ev.action!r}")
     ev.fired = True
@@ -171,7 +210,8 @@ def run_soak(engine_factory, traffic, horizon_s, *,
             now = (_wall() - t0) / time_scale
             for ev in chaos:
                 if not ev.fired and now >= ev.t:
-                    _fire_chaos(ev, router, inj, flight, chaos_log)
+                    _fire_chaos(ev, router, inj, flight, chaos_log,
+                                reqs)
             while idx < len(arrivals) and arrivals[idx].t <= now:
                 a = arrivals[idx]
                 idx += 1
@@ -207,8 +247,18 @@ def run_soak(engine_factory, traffic, horizon_s, *,
              if r.t_first_token is not None]
     finished = sum(1 for r in reqs
                    if r.state == FleetRequestState.FINISHED)
+    quarantined = [r.id for r in reqs
+                   if r.state == FleetRequestState.QUARANTINED]
+    failed = [r.id for r in reqs
+              if r.state == FleetRequestState.FAILED]
     fleet = router.fleet_status()
-    lost = (len(reqs) - finished) + int(fleet["counters"]["lost"])
+    # lost = requests in NO terminal state: a quarantined poison or a
+    # row-failed request was contained and accounted, not lost
+    terminal = (FleetRequestState.FINISHED, FleetRequestState.REJECTED,
+                FleetRequestState.EVICTED, FleetRequestState.FAILED,
+                FleetRequestState.QUARANTINED)
+    lost = (sum(1 for r in reqs if r.state not in terminal)
+            + int(fleet["counters"]["lost"]))
     p99 = _percentile(ttfts, 99)
     report = {
         "wall_s": _wall() - t0,
@@ -216,6 +266,14 @@ def run_soak(engine_factory, traffic, horizon_s, *,
         "timed_out": timed_out,
         "requests_submitted": len(reqs),
         "requests_finished": finished,
+        "requests_quarantined": quarantined,
+        "requests_failed": failed,
+        # per-request outcome: lets callers parity-check innocents
+        # against a poison-free oracle (greedy output is token-
+        # identical no matter what was co-batched or quarantined)
+        "requests": [{"id": r.id, "state": r.state,
+                      "prompt": list(r.prompt), "output": r.output}
+                     for r in reqs],
         "lost_requests": lost,
         "ttft_p50_s": _percentile(ttfts, 50),
         "ttft_p99_s": p99,

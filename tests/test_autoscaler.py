@@ -405,6 +405,91 @@ class TestSpawnDiscipline:
         assert router.replicas[0].state == ReplicaState.HEALTHY
 
 
+# ------------------------------------------- cascade-breaker coordination
+
+
+class TestCascadeBreakerGate:
+    """Autoscaler × cascade-breaker interplay: while the router's
+    breaker is open (a poison storm churning replicas), every scale-up
+    trigger is vetoed — the backlog is failure churn, not demand — with
+    exactly one exception: zero healthy replicas is recovery, and a
+    starved fleet cannot even run canary trials."""
+
+    def _storm(self, clock, n=3, **router_kw):
+        router_kw.setdefault("cascade_threshold", 2)
+        router_kw.setdefault("cascade_window_s", 50.0)
+        router, scaler = _fleet([_stub_factory() for _ in range(n)],
+                                clock, factory=_stub_factory(),
+                                **router_kw)
+        # two uncontrolled replica deaths inside the window: the
+        # probe-miss detection path counts each as a failure event
+        router.kill_replica(0)
+        router.kill_replica(1)
+        for _ in range(2):                 # probe misses → DEAD
+            router.step()
+        assert router.cascade_open()
+        return router, scaler
+
+    def test_open_breaker_vetoes_every_scale_up_trigger(self):
+        clock = _ManualClock()
+        router, scaler = self._storm(clock)
+        survivor = router.replicas[2]
+        assert survivor.state == ReplicaState.HEALTHY
+        # a screaming scale-up signal: pressure far above the band,
+        # with both dead replicas revivable and no cooldown pending
+        survivor.engine.drain = scaler.up_pressure_s * 10
+        for _ in range(6):
+            clock.advance(5.0)             # < window: breaker stays open
+            router.step()
+            assert scaler.tick() is None
+        assert _events(scaler) == {"up": 0, "down": 0}
+        assert scaler.status()["last_signals"]["cascade_open"] is True
+        assert len(router.replicas) == 3   # nothing spawned either
+        assert router.cascade_open()
+
+    def test_burst_during_open_breaker_scales_once_it_closes(self):
+        clock = _ManualClock()
+        router, scaler = self._storm(clock)
+        # the burst arrives MID-storm ...
+        survivor = router.replicas[2]
+        survivor.engine.drain = scaler.up_pressure_s * 10
+        clock.advance(1.0)
+        router.step()
+        assert scaler.tick() is None       # vetoed while open
+        # ... the storm window drains: breaker closes, the still-
+        # present burst scales on the next tick (revive-first)
+        clock.advance(60.0)
+        router.step()
+        assert not router.cascade_open()
+        assert scaler.tick() == ("up", "pressure")
+        assert _events(scaler)["up"] == 1
+        assert any(rep.state == ReplicaState.HEALTHY
+                   and rep.replica_id in (0, 1)
+                   for rep in router.replicas)  # revived, not appended
+        assert len(router.replicas) == 3
+
+    def test_zero_healthy_recovery_bypasses_the_veto(self):
+        clock = _ManualClock()
+        router, scaler = _fleet([_stub_factory(), _stub_factory()],
+                                clock, factory=_stub_factory(),
+                                cascade_threshold=2,
+                                cascade_window_s=50.0)
+        router.kill_replica(0)
+        router.kill_replica(1)
+        for _ in range(2):
+            router.step()
+        assert router.cascade_open()
+        assert all(rep.state == ReplicaState.DEAD
+                   for rep in router.replicas)
+        clock.advance(0.1)
+        # breaker open AND zero healthy: recovery wins — one replica
+        # comes back so canary trials (and innocents) can run at all
+        assert scaler.tick() == ("up", "no_capacity")
+        assert router.cascade_open()       # the breaker itself stays open
+        assert sum(1 for rep in router.replicas
+                   if rep.state == ReplicaState.HEALTHY) == 1
+
+
 # ---------------------------------------------------- status surface
 
 

@@ -1,0 +1,470 @@
+"""Blast-radius containment: per-row FAILED isolation in the engine,
+the router's poison-request suspicion → canary trial → QUARANTINE
+pipeline, the fleet cascade breaker, and the supporting plumbing
+(deterministic failover re-enqueue order, the ``router.canary_dispatch``
+fault site, the soft-breaker ``/healthz`` fold).
+
+The acceptance matrix mirrors the zero-loss failover contract one
+level down: a request that *causes* failures is contained — terminal
+``FAILED`` (row-attributable) or ``QUARANTINED`` (replica-killing) with
+evidence attached — while every innocent co-batched / co-scheduled
+request still finishes with greedy output token-identical to a
+poison-free run, and the number of uncontrolled replica kills a single
+poison pattern can cause is bounded by ``canary_threshold + 1``.
+"""
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_forward, gpt_init
+from paddle_tpu.observability.exporter import start_telemetry_server
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.resilience import FaultSpec, injected_faults
+from paddle_tpu.serving import (Engine, FleetRequestState, FleetRouter,
+                                ReplicaState, RequestState, SamplingParams)
+
+
+def _tiny_cfg():
+    # fp32: parity asserts compare argmax across replicas / re-dispatch
+    return dataclasses.replace(GPT_CONFIGS["tiny"], dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny_cfg()
+    params = gpt_init(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, params
+
+
+_ORACLE_FWD = {}
+
+
+def naive_generate(cfg, params, prompt, n_new):
+    """Full-recompute greedy decoding — the poison-free oracle."""
+    fwd = _ORACLE_FWD.get(id(cfg))
+    if fwd is None:
+        fwd = _ORACLE_FWD.setdefault(
+            id(cfg), jax.jit(lambda p, t: gpt_forward(cfg, p, t)))
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = fwd(params, jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _factory(cfg, params, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("chunk_len", 8)
+
+    def make():
+        return Engine(cfg, params, **kw)
+
+    return make
+
+
+def _router(cfg, params, n=3, engine_kw=None, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("canary_threshold", 2)
+    kw.setdefault("cascade_threshold", 2)
+    kw.setdefault("cascade_window_s", 3.0)
+    return FleetRouter([_factory(cfg, params, **(engine_kw or {}))] * n,
+                       **kw)
+
+
+def _settle(router, ticks=400):
+    for i in range(ticks):
+        if not router.has_work():
+            return i
+        router.step()
+    raise AssertionError(f"fleet did not settle in {ticks} ticks")
+
+
+# ------------------------------------------------- per-row isolation
+
+
+class TestPerRowIsolation:
+    def test_row_failure_pins_failed_and_spares_the_batch(
+            self, tiny_model):
+        """An exception attributable to ONE row (its page-table lookup
+        explodes mid-plan) retires that request terminal FAILED — pages
+        freed, trace closed on the error — while the co-batched request
+        finishes token-identical and the engine keeps serving."""
+        cfg, params = tiny_model
+        eng = _factory(cfg, params)()
+        rng = np.random.RandomState(7)
+        good_prompt = list(rng.randint(0, cfg.vocab_size, 6))
+        ref = naive_generate(cfg, params, good_prompt, 4)
+        sp = SamplingParams(max_new_tokens=4)
+        good = eng.add_request(good_prompt, sp)
+        bad = eng.add_request(list(rng.randint(0, cfg.vocab_size, 5)), sp)
+
+        real = eng.cache.page_table
+
+        def sabotaged(seq_id):
+            if seq_id == bad.id:
+                raise RuntimeError("synthetic row fault")
+            return real(seq_id)
+
+        eng.cache.page_table = sabotaged
+        for _ in range(60):
+            if not eng.has_work():
+                break
+            eng.step()
+        eng.cache.page_table = real
+
+        assert bad.state == RequestState.FAILED
+        assert "synthetic row fault" in bad.finish_reason
+        assert good.state == RequestState.FINISHED
+        assert good.output == ref
+        assert eng.metrics.requests_failed.value == 1
+        # the failed row's pages came back to the pool
+        assert eng.cache.num_used_pages == 0
+        # the engine is alive — a fresh request sails through
+        again = eng.add_request(good_prompt, sp)
+        for _ in range(60):
+            if not eng.has_work():
+                break
+            eng.step()
+        assert again.state == RequestState.FINISHED
+        assert again.output == ref
+
+    def test_commit_failure_is_row_scoped_too(self, tiny_model):
+        """A failure in the post-step commit path (sampling /
+        bookkeeping) of one row leaves the other rows committing
+        normally."""
+        cfg, params = tiny_model
+        eng = _factory(cfg, params)()
+        rng = np.random.RandomState(11)
+        good_prompt = list(rng.randint(0, cfg.vocab_size, 7))
+        ref = naive_generate(cfg, params, good_prompt, 4)
+        sp = SamplingParams(max_new_tokens=4)
+        good = eng.add_request(good_prompt, sp)
+        bad = eng.add_request(list(rng.randint(0, cfg.vocab_size, 6)), sp)
+
+        real = eng._sample_token
+
+        def sabotaged(logits_row, req):
+            if req.id == bad.id:
+                raise ValueError("synthetic commit fault")
+            return real(logits_row, req)
+
+        eng._sample_token = sabotaged
+        for _ in range(60):
+            if not eng.has_work():
+                break
+            eng.step()
+        assert bad.state == RequestState.FAILED
+        assert good.state == RequestState.FINISHED
+        assert good.output == ref
+
+    def test_fleet_surfaces_row_failure_as_failed_not_failover(
+            self, tiny_model):
+        """A row-attributable failure under the router stays FAILED on
+        that fleet request — no replica failover, no suspicion charged
+        to innocent co-tenants."""
+        cfg, params = tiny_model
+        router = _router(cfg, params, n=2)
+        rng = np.random.RandomState(3)
+        good_prompt = list(rng.randint(0, cfg.vocab_size, 6))
+        ref = naive_generate(cfg, params, good_prompt, 4)
+        sp = SamplingParams(max_new_tokens=4)
+        good = router.submit(good_prompt, sp)
+        bad = router.submit(list(rng.randint(0, cfg.vocab_size, 8)), sp)
+        router.step()                      # dispatch both
+        assert bad.replica_id is not None
+        eng = router.replicas[bad.replica_id].engine
+        real = eng.cache.page_table
+        bad_engine_id = bad._engine_req.id
+
+        def sabotaged(seq_id):
+            if seq_id == bad_engine_id:
+                raise RuntimeError("synthetic row fault")
+            return real(seq_id)
+
+        eng.cache.page_table = sabotaged
+        _settle(router)
+        eng.cache.page_table = real
+        snap = router.metrics.snapshot()
+        assert bad.state == FleetRequestState.FAILED
+        assert "synthetic row fault" in bad.finish_reason
+        assert bad.redispatches == 0       # not a failover
+        assert good.state == FleetRequestState.FINISHED
+        assert good.output == ref
+        assert snap["failure_events"] == 0
+        assert snap["lost"] == 0
+        assert all(rep.state == ReplicaState.HEALTHY
+                   for rep in router.replicas)
+
+
+# ---------------------------------------- poison → canary → quarantine
+
+
+@pytest.mark.faultinject
+class TestPoisonQuarantine:
+    def test_poison_request_quarantined_innocents_token_identical(
+            self, tiny_model):
+        """The tentpole end-to-end: a poison_request fault armed on a
+        token pattern kills whatever replica co-batches it; after
+        ``canary_threshold`` distinct uncontrolled kills the suspect is
+        re-admitted ALONE on a canary replica, killing the canary
+        convicts it (terminal QUARANTINED with evidence), and every
+        innocent finishes greedy-token-identical to a poison-free run.
+        Uncontrolled kills are bounded by canary_threshold + 1."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(0)
+        innocents = [list(rng.randint(0, cfg.vocab_size, n))
+                     for n in (5, 9, 7, 11)]
+        refs = [naive_generate(cfg, params, p, 6) for p in innocents]
+        poison = [7, 8, 9, 10]
+
+        router = _router(cfg, params, n=3)
+        sp = SamplingParams(max_new_tokens=6)
+        with injected_faults(FaultSpec("serving.step", "poison_request",
+                                       pattern=(7, 8, 9))):
+            reqs = [router.submit(p, sp) for p in innocents[:2]]
+            preq = router.submit(poison, sp)
+            reqs += [router.submit(p, sp) for p in innocents[2:]]
+            _settle(router)
+
+        snap = router.metrics.snapshot()
+        assert preq.state == FleetRequestState.QUARANTINED
+        ev = preq.quarantine_evidence
+        assert ev["suspicion"] >= 2
+        assert len(ev["failure_events"]) == ev["suspicion"]
+        assert ev["canary_replica"] is not None
+        # innocents: all finished, token-identical — never taxed
+        assert [r.state for r in reqs] == \
+            [FleetRequestState.FINISHED] * len(reqs)
+        assert [r.output for r in reqs] == refs
+        # blast radius: at most canary_threshold + 1 replica kills,
+        # and the canary death was the controlled (+1) one
+        assert snap["failure_events"] <= 3
+        assert snap["canary_deaths"] == 1
+        assert snap["canary_dispatches"] >= 1
+        assert snap["quarantined"] == 1
+        assert snap["cascade_breaker_opens"] == 1
+        assert snap["lost"] == 0
+        # the quarantined request's trace is tail-retained with the
+        # quarantine verdict on it
+        kept = {t["name"]: t for t in router.tracer.traces()
+                if t.get("retained")}
+        qt = [t for t in kept.values() if t["retained"] == "quarantined"]
+        assert qt, sorted(kept)
+        assert any(s.get("name") == "router::quarantine"
+                   for t in qt for s in t.get("spans", ()))
+
+    def test_convicted_prompt_sibling_quarantined_at_admission(
+            self, tiny_model):
+        """Conviction outlives the convicted request: a later request
+        with the same prompt content is quarantined at admission —
+        zero additional replica kills for a repeated poison."""
+        cfg, params = tiny_model
+        router = _router(cfg, params, n=3)
+        poison = [7, 8, 9, 10]
+        sp = SamplingParams(max_new_tokens=6)
+        with injected_faults(FaultSpec("serving.step", "poison_request",
+                                       pattern=(7, 8, 9))):
+            preq = router.submit(poison, sp)
+            _settle(router)
+            kills_before = router.metrics.snapshot()["failure_events"]
+            sibling = router.submit(list(poison), sp)
+            _settle(router)
+        assert preq.state == FleetRequestState.QUARANTINED
+        assert sibling.state == FleetRequestState.QUARANTINED
+        assert sibling.quarantine_evidence["convicted_sibling"] is True
+        snap = router.metrics.snapshot()
+        assert snap["failure_events"] == kills_before  # zero new kills
+        assert snap["quarantined"] == 2
+        assert snap["lost"] == 0
+
+    def test_benign_suspect_survives_canary_trial_and_is_exonerated(
+            self, tiny_model):
+        """A request that accrued suspicion by riding along with real
+        failures (not by causing them) survives its canary trial:
+        it finishes token-identical and its suspicion entry is
+        dropped.  Also exercises the ``router.canary_dispatch`` fault
+        site: a transient io_error on the first dispatch attempt keeps
+        the suspect at the queue head and the next tick retries."""
+        cfg, params = tiny_model
+        rng = np.random.RandomState(5)
+        prompt = list(rng.randint(0, cfg.vocab_size, 8))
+        ref = naive_generate(cfg, params, prompt, 5)
+        router = _router(cfg, params, n=2)
+        sp = SamplingParams(max_new_tokens=5)
+        with injected_faults(
+                FaultSpec("router.canary_dispatch", "io_error",
+                          occurrence=1)):
+            req = router.submit(prompt, sp)
+            # charge two distinct failure events by hand — the innocent
+            # was aboard for two unrelated replica deaths
+            router._suspects[req._prompt_key] = {1, 2}
+            router.step()              # canary dispatch faults: io_error
+            assert req.state == FleetRequestState.PENDING
+            assert all(rep.canary_for is None for rep in router.replicas)
+            _settle(router)            # retried next tick, then runs
+        snap = router.metrics.snapshot()
+        assert req.state == FleetRequestState.FINISHED
+        assert req.output == ref
+        assert req._prompt_key not in router._suspects   # exonerated
+        assert snap["canary_dispatches"] == 1
+        assert snap["canary_deaths"] == 0
+        assert snap["quarantined"] == 0
+        assert all(rep.canary_for is None for rep in router.replicas)
+
+    def test_canary_runs_suspect_alone(self, tiny_model):
+        """While a suspect is on trial, its reserved replica admits
+        nothing else — no innocent is ever co-batched with a suspect."""
+        cfg, params = tiny_model
+        router = _router(cfg, params, n=2)
+        sp = SamplingParams(max_new_tokens=6)
+        rng = np.random.RandomState(9)
+        suspect = router.submit(list(rng.randint(0, cfg.vocab_size, 8)),
+                                sp)
+        router._suspects[suspect._prompt_key] = {1, 2}
+        others = [router.submit(list(rng.randint(0, cfg.vocab_size, 6)),
+                                sp) for _ in range(3)]
+        router.step()
+        canaries = [rep for rep in router.replicas
+                    if rep.canary_for == suspect.id]
+        assert len(canaries) == 1
+        rep = canaries[0]
+        table = router._assigned[rep.replica_id]
+        assert set(table) == {suspect.id}  # the suspect rides alone
+        assert all(o.replica_id != rep.replica_id
+                   for o in others if o.replica_id is not None)
+        _settle(router)
+        assert suspect.state == FleetRequestState.FINISHED
+        assert all(o.state == FleetRequestState.FINISHED for o in others)
+
+
+# --------------------------------------------- failover re-enqueue order
+
+
+@pytest.mark.faultinject
+class TestFailoverOrder:
+    def test_reclaim_re_enqueues_in_admission_order(self, tiny_model):
+        """Harvested in-flight requests re-enter the queue at the head
+        in their original admission order (ascending request id), not
+        the assignment table's dict order."""
+        cfg, params = tiny_model
+        router = _router(cfg, params, n=2,
+                         engine_kw={"max_batch_size": 4})
+        rng = np.random.RandomState(2)
+        sp = SamplingParams(max_new_tokens=12)
+        reqs = [router.submit(list(rng.randint(0, cfg.vocab_size, 6)),
+                              sp) for _ in range(6)]
+        router.step()                     # dispatch across both replicas
+        victim = router.replicas[0]
+        aboard = sorted(router._assigned[victim.replica_id])
+        assert len(aboard) >= 2           # a multi-request harvest
+        # scramble the assignment table's insertion order to prove the
+        # re-enqueue does NOT depend on it
+        table = router._assigned[victim.replica_id]
+        items = list(table.items())[::-1]
+        table.clear()
+        table.update(items)
+        router.kill_replica(0)
+        # drive the detection path directly so the harvest is
+        # observable in _pending before the next admission pass
+        router._on_replica_failure(victim, "killed",
+                                   OSError("replica 0 process is dead"))
+        moved = [f.id for f in router._pending
+                 if f.id in set(aboard)]
+        assert moved == aboard            # ascending admission order
+        _settle(router)
+        assert all(r.state == FleetRequestState.FINISHED for r in reqs)
+        assert router.metrics.snapshot()["lost"] == 0
+
+
+# ------------------------------------------------- breaker + health fold
+
+
+@pytest.mark.faultinject
+class TestBreakerHealthFold:
+    def test_fleet_health_soft_breaker_and_healthz_200(self, tiny_model):
+        """An open cascade breaker with >= 1 admittable replica is a
+        soft condition: /fleet exposes quarantine count + breaker
+        state, and /healthz stays 200 because the fleet still serves
+        (suspects drain through canary mode; innocents keep going)."""
+        cfg, params = tiny_model
+        registry = MetricsRegistry()
+        # a LONG window so the breaker is still open after the poison
+        # is contained — observable state, not a race
+        router = _router(cfg, params, n=3, registry=registry,
+                         cascade_window_s=60.0)
+        server = start_telemetry_server(port=0, router=router,
+                                        registry=registry,
+                                        tracer=router.tracer)
+        try:
+            rng = np.random.RandomState(1)
+            sp = SamplingParams(max_new_tokens=6)
+            poison = [7, 8, 9, 10]
+            with injected_faults(
+                    FaultSpec("serving.step", "poison_request",
+                              pattern=(7, 8, 9))):
+                preq = router.submit(poison, sp)
+                innocent = router.submit(
+                    list(rng.randint(0, cfg.vocab_size, 6)), sp)
+                _settle(router)
+            assert preq.state == FleetRequestState.QUARANTINED
+            assert innocent.state == FleetRequestState.FINISHED
+            assert router.cascade_open()   # 60s window: still open
+            fh = router.fleet_health()
+            assert fh["cascade_breaker_open"] is True
+            assert fh["quarantined"] == 1
+            assert fh["suspects"] == 0     # drained, not lingering
+            assert fh["healthy"] is True   # soft: fleet still admits
+
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/healthz") as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+            assert body["healthy"] is True
+            assert body["cascade_breaker_open"] is True
+            assert body["quarantined"] == 1
+            with urllib.request.urlopen(base + "/fleet") as resp:
+                fleet = json.loads(resp.read())
+            assert fleet["quarantined"] == 1
+            assert fleet["cascade_breaker_open"] is True
+            assert fleet["counters"]["quarantined"] == 1
+        finally:
+            server.shutdown()
+
+    def test_breaker_closes_when_window_drains(self, tiny_model):
+        """With no failures left in the window, no canary in flight and
+        no queued suspects, the breaker closes and the router::cascade
+        trace ends with the quarantine tally."""
+        cfg, params = tiny_model
+        clock = [0.0]
+        router = _router(cfg, params, n=3, cascade_window_s=2.0,
+                         clock=lambda: clock[0])
+        sp = SamplingParams(max_new_tokens=6)
+        with injected_faults(FaultSpec("serving.step", "poison_request",
+                                       pattern=(7, 8, 9))):
+            preq = router.submit([7, 8, 9, 10], sp)
+            for _ in range(400):
+                if not router.has_work():
+                    break
+                clock[0] += 0.01
+                router.step()
+        assert preq.state == FleetRequestState.QUARANTINED
+        assert router.cascade_open()
+        clock[0] += 5.0                    # window empties
+        router.step()
+        assert not router.cascade_open()
+        snap = router.metrics.snapshot()
+        assert snap["cascade_breaker_opens"] == 1
+        assert snap["cascade_breaker_open"] == 0
+        cascade = [t for t in router.tracer.traces()
+                   if t["name"] == "router::cascade"]
+        assert cascade
+        root = cascade[0]["spans"][0]
+        assert root["attributes"]["quarantined_total"] == 1

@@ -1,11 +1,17 @@
 """Compressed chaos soak — the tier-1 variant of ``bench.py --section
 soak``: a seeded diurnal/bursty trace through an autoscaled real-engine
 fleet while the chaos timeline fires a hard kill, admission and
-control-loop stalls, and a spawn io_error (the fault sites
+control-loop stalls, a spawn io_error (the fault sites
 ``autoscaler.poll`` / ``autoscaler.scale_up`` / ``serving.admit``),
-asserting the invariants end-to-end: ``lost_requests == 0``, bounded
-TTFT p99, at least one scale-up AND one scale-down recorded in the
-live-scraped ``/fleet``, every chaos event visible in ``/flight``.
+and a live-state ``bitflip`` at ``serving.step``, asserting the
+invariants end-to-end: ``lost_requests == 0``, bounded TTFT p99, at
+least one scale-up AND one scale-down recorded in the live-scraped
+``/fleet``, every chaos event visible in ``/flight``.  A second
+scenario drives a ``poison_storm`` through the same harness and
+asserts the blast-radius containment contract: every poison ends
+terminal QUARANTINED, uncontrolled replica kills stay bounded by
+``canary_threshold + 1``, and innocents finish token-identical to a
+poison-free oracle.
 """
 import dataclasses
 
@@ -14,7 +20,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_init
+from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_forward, gpt_init
 from paddle_tpu.observability.metrics import MetricsRegistry
 from paddle_tpu.serving import ChaosEvent, Engine, TrafficGenerator, run_soak
 
@@ -28,6 +34,23 @@ def tiny_model():
     cfg = _tiny_cfg()
     params = gpt_init(cfg, jax.random.key(0), dtype=jnp.float32)
     return cfg, params
+
+
+# stable jitted forward — the poison-free greedy oracle (shared jit
+# cache: an eager gpt_forward would recompile per call)
+_ORACLE_FWD = {}
+
+
+def naive_generate(cfg, params, prompt, n_new):
+    fwd = _ORACLE_FWD.get(id(cfg))
+    if fwd is None:
+        fwd = _ORACLE_FWD.setdefault(
+            id(cfg), jax.jit(lambda p, t: gpt_forward(cfg, p, t)))
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = fwd(params, jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
 
 
 def _engine_factory(tiny_model):
@@ -57,6 +80,10 @@ class TestCompressedSoak:
             ChaosEvent(t=1.5, action="stall_admit", stall_s=0.4),
             ChaosEvent(t=2.5, action="kill"),
             ChaosEvent(t=3.0, action="stall_poll", stall_s=0.3),
+            # one seeded bit flips in a live KV page: silent corruption
+            # whose blast radius must be at most one request's output —
+            # nothing raises, nobody dies, the accounting stays exact
+            ChaosEvent(t=3.5, action="bitflip"),
         ]
         report = run_soak(
             _engine_factory(tiny_model), traffic, horizon_s=8.0,
@@ -92,13 +119,18 @@ class TestCompressedSoak:
 
         # ---- the whole kill matrix actually fired
         assert all(ev["action"] in ("kill", "stall_admit", "stall_poll",
-                                    "spawn_io_error")
+                                    "spawn_io_error", "bitflip")
                    for ev in report["chaos"])
-        assert len(report["chaos"]) == 4
+        assert len(report["chaos"]) == 5
         fired_sites = {f["site"] for f in report["injector_fired"]}
         assert "serving.admit" in fired_sites
         assert "autoscaler.poll" in fired_sites
         assert "autoscaler.scale_up" in fired_sites
+        assert "serving.step" in fired_sites      # the bitflip landed
+        # the bitflip corrupted at most one request's *output*, never
+        # the fleet: nothing quarantined, no cascade, zero loss above
+        assert report["requests_quarantined"] == []
+        assert report["fleet"]["cascade_breaker_open"] is False
         # the killed replica's in-flight work was re-dispatched (unless
         # it happened to be idle at kill time — redispatch also comes
         # from drains, so usually > 0)
@@ -117,7 +149,8 @@ class TestCompressedSoak:
         flight_ops |= set(flight["summary"]["by_op"])
         soak_ops = {op for op in flight_ops if op.startswith("soak::")}
         assert {"soak::kill", "soak::stall_admit", "soak::stall_poll",
-                "soak::spawn_io_error"} <= soak_ops, flight_ops
+                "soak::spawn_io_error", "soak::bitflip"} <= soak_ops, \
+            flight_ops
 
         # ---- merged fleet trace view over live HTTP: a hard-killed-
         # and-failed-over request reads as ONE trace — one entry per
@@ -139,3 +172,87 @@ class TestCompressedSoak:
                 # tail retention pinned it (failover, or a stronger
                 # reason like a fault event recorded on a span)
                 assert t["retained"] != "sampled", t["retained"]
+
+    def test_poison_storm_containment(self, tiny_model):
+        """The compressed poison-storm scenario: 3 poison requests
+        (same query-of-death pattern) land mid-trace on a 3-replica
+        fleet with the cascade breaker at K=2.  The containment
+        contract, end-to-end through the soak harness:
+
+        - every poison ends terminal QUARANTINED (accounted, not lost);
+        - uncontrolled replica kills stay <= K+1 — suspicion pins the
+          pattern after 2 kills, the canary trial eats the third, and
+          conviction covers the storm's siblings for free;
+        - innocents lose nothing and their greedy output is
+          token-identical to a poison-free oracle run;
+        - the quarantines are visible on the live-scraped ``/fleet``
+          and the quarantined traces survive in the tail-retained ring.
+        """
+        cfg, params = tiny_model
+        pattern = (7, 8, 9)
+        traffic = TrafficGenerator(
+            base_rate_per_s=4.0, diurnal_amplitude=0.5,
+            day_period_s=6.0, phase_s=0.0, bursts=(),
+            n_cohorts=2, cohort_prefix_len=8, cohort_fraction=0.4,
+            prompt_len=(8, 20), max_new_tokens=(4, 6),
+            vocab_size=cfg.vocab_size, seed=99)
+        chaos = [ChaosEvent(t=1.0, action="poison_storm",
+                            pattern=pattern, count=3, max_new_tokens=6)]
+        report = run_soak(
+            _engine_factory(tiny_model), traffic, horizon_s=6.0,
+            initial_replicas=3, chaos=chaos,
+            registry=MetricsRegistry(),
+            router_kw=dict(canary_threshold=2, cascade_threshold=2,
+                           cascade_window_s=2.0),
+            scaler_kw=dict(min_replicas=1, max_replicas=3,
+                           up_pressure_s=1.0, down_pressure_s=0.15,
+                           up_pending_depth=4,
+                           scale_up_cooldown_s=1.5,
+                           scale_down_cooldown_s=2.0,
+                           spawn_max_retries=2,
+                           spawn_backoff_base_s=0.01,
+                           spawn_backoff_cap_s=0.05),
+            deadline_s=40.0, grace_s=8.0, min_down_events=0,
+            ttft_bound_s=25.0)
+
+        assert not report["timed_out"], report
+        storm_ids = set(report["chaos"][0]["detail"]["request_ids"])
+        assert len(storm_ids) == 3
+
+        # ---- every poison terminal QUARANTINED, nothing lost
+        assert set(report["requests_quarantined"]) == storm_ids
+        assert report["lost_requests"] == 0, report
+        assert report["requests_failed"] == []
+
+        # ---- blast radius: <= K+1 uncontrolled kills for the whole
+        # storm; the canary death was the controlled one
+        counters = report["fleet"]["counters"]
+        assert counters["failure_events"] <= 3, counters
+        assert counters["canary_deaths"] >= 1
+        assert counters["quarantined"] == 3
+        assert counters["cascade_breaker_opens"] >= 1
+
+        # ---- innocents: all finished, token-identical to the
+        # poison-free oracle (sampled — the oracle recompiles per
+        # sequence length, so parity-check a deterministic subset)
+        innocents = [r for r in report["requests"]
+                     if r["id"] not in storm_ids]
+        assert innocents
+        assert all(r["state"] == "finished" for r in innocents)
+        assert report["requests_finished"] == len(innocents)
+        for r in innocents[:6]:
+            n_new = len(r["output"])
+            assert r["output"] == naive_generate(cfg, params,
+                                                 r["prompt"], n_new)
+
+        # ---- containment visible from the outside: /fleet carries
+        # the quarantine count, the trace ring retains the verdicts
+        scraped = report["scraped"]
+        assert scraped["fleet"]["quarantined"] == 3
+        assert scraped["fleet"]["counters"]["quarantined"] == 3
+        retained = [t for t in scraped["traces"]["traces"]
+                    if t.get("retained") == "quarantined"]
+        assert len(retained) >= 1, \
+            [t.get("retained") for t in scraped["traces"]["traces"]]
+        assert any(s["name"] == "router::quarantine"
+                   for t in retained for s in t["spans"])

@@ -1,11 +1,17 @@
 """Rule ``fault-sites``: every fault site registered under paddle_tpu/
-must be exercised by at least one test.
+must be exercised by at least one test — and every fault *kind* too.
 
 Collects every site name declared in the package (positional
 ``fault_point("...")`` literals and ``site="..."`` keyword literals)
 and checks that each name appears somewhere under tests/.  Keyword
 *defaults* (like ``atomic_write``'s ``site="io.write"``) declare a
 parameter, not a site, and are skipped.
+
+Kinds ride the same rule: the ``FAULT_KINDS`` tuple assignment in
+``resilience/faults.py`` is read by AST (kill / torn_write / io_error /
+stall / bitflip / poison_request, plus whatever a later PR adds) and
+each kind must appear in the tests blob — a fault kind nobody can
+inject in a test is dead chaos surface.
 """
 from __future__ import annotations
 
@@ -43,7 +49,31 @@ def _collect(project):
     return sites
 
 
-@register(RULE, "every fault site exercised by a test")
+def _collect_kinds(project):
+    """``{kind: (mod, lineno)}`` from the ``FAULT_KINDS = (...)``
+    tuple assignment in ``resilience/faults.py`` (AST, not import)."""
+    kinds = {}
+    for mod in project.modules():
+        if not mod.rel.endswith("resilience/faults.py"):
+            continue
+        tree = mod.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "FAULT_KINDS"
+                       for t in node.targets):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        kinds.setdefault(elt.value, (mod, elt.lineno))
+    return kinds
+
+
+@register(RULE, "every fault site and fault kind exercised by a test")
 def find(project):
     sites = _collect(project)
     blob = project.tests_blob()
@@ -55,6 +85,12 @@ def find(project):
                 f"fault site {name!r} has no exercising test — add a "
                 f"matrix case (e.g. injected_faults(FaultSpec"
                 f"({name!r}, ...)))"))
+    for kind, (mod, lineno) in sorted(_collect_kinds(project).items()):
+        if kind not in blob:
+            out.append(Finding(
+                mod.rel, lineno, RULE,
+                f"fault kind {kind!r} has no exercising test — inject "
+                f"it somewhere (FaultSpec(<site>, {kind!r}, ...))"))
     return out
 
 
