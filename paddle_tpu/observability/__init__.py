@@ -53,6 +53,25 @@ platform/monitor.h grown into a production observability stack):
   lagging rank and the first divergent seq/op) and dumps atomic debug
   bundles; the telemetry server's ``/flight`` endpoint and the
   ``TrainingSupervisor``'s ``on_hang`` escalation ride it.
+- :mod:`.timeseries` — the in-process time-series store:
+  :class:`TimeSeriesStore` scrapes a :class:`MetricsRegistry` into
+  fixed-budget per-series rings on an injectable clock (opt-in thread,
+  nothing on import), detects counter resets (a
+  ``register(replace=True)`` engine rebuild mid-soak never reads as
+  negative traffic), and answers the windowed queries raw lifetime
+  counters cannot: ``rate``/``delta``/``avg``/``slope`` and
+  histogram-bucket-delta ``quantile``/``good_below`` — "TTFT p99 over
+  the LAST minute", not since process start.  Served at
+  ``/timeseries``.
+- :mod:`.slo` — the governing layer over the store: declarative
+  :class:`SLO` objectives (availability / goodput / latency-threshold
+  forms), error-budget tracking, and :class:`BurnRateAlert`
+  multi-window multi-burn-rate alerts (fast-burn page + slow-burn
+  ticket, fire-once/sticky with clear hysteresis — the SRE-workbook
+  shape).  :class:`SLOEngine` emits ``slo_*`` metrics, tail-retained
+  ``slo::<name>`` transition spans, the ``/slo`` endpoint payload, the
+  ``/healthz`` page fold, and the autoscaler's escalation/scale-down
+  inputs.  Severities come from the fixed :data:`SEVERITIES` enum.
 - the step-aware :class:`~paddle_tpu.profiler.Profiler` (re-exported
   here lazily to avoid an import cycle): ``make_scheduler`` windows,
   step-boundary instant events, and registry gauges emitted as
@@ -103,6 +122,15 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     default_registry,
 )
+from .slo import (  # noqa: F401
+    SEVERITIES,
+    SLO,
+    BurnRateAlert,
+    SLOEngine,
+)
+from .timeseries import (  # noqa: F401
+    TimeSeriesStore,
+)
 from .tracing import (  # noqa: F401
     Span,
     Tracer,
@@ -122,6 +150,8 @@ __all__ = [
     "CollectiveRecord", "FlightRecorder", "HangWatchdog",
     "default_flight_recorder", "use_flight_recorder",
     "record_collective",
+    "TimeSeriesStore",
+    "SEVERITIES", "SLO", "BurnRateAlert", "SLOEngine",
     # lazy (profiler leg)
     "Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
     "export_chrome_tracing",

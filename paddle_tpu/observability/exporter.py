@@ -45,6 +45,16 @@ enforces that):
                 check counts, last cross-rank-verified step, active
                 divergence state and recent events — 404 when no
                 sentinel is attached
+  ``/slo``      the SLO engine: per-objective spec, live burn rates,
+                remaining error budget, per-alert state and the recent
+                fire/clear transition log — 404 when no engine is
+                attached; a firing fast-burn *page* also folds into
+                ``/healthz`` (503 — someone must look NOW)
+  ``/timeseries``  the in-process time-series store: budget/usage
+                summary, or with ``?name=<series>`` (plus optional
+                ``window_seconds=`` and label params) the windowed
+                rate/delta/avg/slope/quantile answers — "when did
+                memory start growing" — 404 when no store is attached
   ===========  ========================================================
 
   ``port=0`` binds an ephemeral port (read it back from
@@ -279,6 +289,29 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(srv.integrity.report(),
                                                default=str))
+            elif url.path == "/slo":
+                if srv.slo is None:
+                    self._send(404, json.dumps(
+                        {"error": "no slo engine attached"}))
+                else:
+                    self._send(200, json.dumps(srv.slo.status(),
+                                               default=str))
+            elif url.path == "/timeseries":
+                if srv.timeseries is None:
+                    self._send(404, json.dumps(
+                        {"error": "no time-series store attached"}))
+                else:
+                    q = parse_qs(url.query)
+                    if "name" in q:
+                        window = float(q.pop("window_seconds",
+                                             ["60"])[0])
+                        name = q.pop("name")[0]
+                        labels = {k: v[0] for k, v in q.items()} or None
+                        self._send(200, json.dumps(
+                            srv.timeseries.query(name, labels, window)))
+                    else:
+                        self._send(200, json.dumps(
+                            srv.timeseries.stats()))
             else:
                 self._send(404, json.dumps({"error": "not found",
                                             "path": url.path}))
@@ -298,7 +331,8 @@ class TelemetryServer(ThreadingHTTPServer):
 
     def __init__(self, addr, registry, tracer, engine, watchdog,
                  aggregator=None, flight=None, hang=None, router=None,
-                 integrity=None, fleet_traces=None):
+                 integrity=None, fleet_traces=None, slo=None,
+                 timeseries=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
@@ -309,6 +343,8 @@ class TelemetryServer(ThreadingHTTPServer):
         self.hang = hang
         self.router = router
         self.integrity = integrity
+        self.slo = slo
+        self.timeseries = timeseries
         self._fleet_traces = fleet_traces
         self._serve_thread = None
 
@@ -392,13 +428,26 @@ class TelemetryServer(ThreadingHTTPServer):
         else:
             g = gauge_value("integrity_divergence_active")
             divergence = bool(g) if g is not None else None
+        # SLO fold: 503 while a fast-burn *page* alert is firing — the
+        # error budget is emptying faster than a human response time,
+        # which is exactly what a page means.  A slow-burn ticket stays
+        # soft (visible on /slo, not an outage).  Without an attached
+        # engine the slo_page_active gauge is folded instead; absent
+        # signal = healthy, like every other leg.
+        if self.slo is not None:
+            slo_page = bool(self.slo.page_active())
+        else:
+            g = gauge_value("slo_page_active")
+            slo_page = bool(g) if g is not None else None
         out["training_healthy"] = training
         out["hang_active"] = hang_active
         out["integrity_divergence_active"] = divergence
+        out["slo_page_active"] = slo_page
         out["healthy"] = (bool(out.get("healthy", True))
                           and training is not False
                           and not hang_active
-                          and not divergence)
+                          and not divergence
+                          and not slo_page)
         return out
 
     def flightz(self):
@@ -455,7 +504,8 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                            tracer=None, engine=None, watchdog=None,
                            aggregator=None, flight=None, hang=None,
                            router=None, integrity=None,
-                           fleet_traces=None):
+                           fleet_traces=None, slo=None,
+                           timeseries=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -483,8 +533,13 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     list, e.g. a ``collect_fleet_traces(store, ids)`` closure) backs
     ``/traces?fleet=1``; without it the attached router's
     ``collect_traces()`` is used, and with neither the fleet view
-    404s.  Never called on import anywhere in the framework —
-    telemetry is strictly opt-in.
+    404s.  ``slo`` (an :class:`~paddle_tpu.observability.slo.SLOEngine`)
+    serves ``/slo`` and makes ``/healthz`` go 503 while a fast-burn
+    page alert is firing (without one the ``slo_page_active`` gauge is
+    folded instead); ``timeseries`` (a
+    :class:`~paddle_tpu.observability.timeseries.TimeSeriesStore`)
+    serves ``/timeseries``.  Never called on import anywhere in the
+    framework — telemetry is strictly opt-in.
     """
     if tracer is None:
         if engine is not None and getattr(engine, "tracer", None):
@@ -497,5 +552,6 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                           registry or default_registry(), tracer,
                           engine, watchdog, aggregator=aggregator,
                           flight=flight, hang=hang, router=router,
-                          integrity=integrity, fleet_traces=fleet_traces)
+                          integrity=integrity, fleet_traces=fleet_traces,
+                          slo=slo, timeseries=timeseries)
     return srv._start()

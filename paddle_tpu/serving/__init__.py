@@ -213,6 +213,19 @@ signals.  Semantics it guarantees:
 - **observability** — ``autoscaler_scale_events_total{direction,
   reason}`` / ``autoscaler_target_replicas`` / ``autoscaler::scale``
   spans, and an ``autoscaler`` block folded into ``/fleet``.
+- **SLO coupling** (both optional) — with a
+  :class:`~paddle_tpu.observability.timeseries.TimeSeriesStore`
+  attached (``timeseries=``), the shed and goodput signals become
+  ``signal_window_s``-windowed, counter-reset-safe store deltas
+  instead of tick-to-tick counter differences; with an
+  :class:`~paddle_tpu.observability.slo.SLOEngine` attached
+  (``slo=``), a firing fast-burn **page** escalates scale-up past the
+  hysteresis band (reason ``slo_fast_burn`` — budget emptying at page
+  speed IS demand, even before pressure catches up; cooldown,
+  ``max_replicas`` and the cascade veto still bound it), and
+  scale-down additionally requires a *healthy* budget: no alert
+  active and every objective retaining at least
+  ``slo_down_min_budget`` of its error budget.
 
 Distributed-tracing contract (paddle_tpu.observability.tracing +
 :mod:`router` — README "Distributed tracing"): every request carries

@@ -48,7 +48,10 @@ import urllib.error
 import urllib.request
 
 from ..observability.flight import FlightRecorder
-from ..observability.exporter import start_telemetry_server
+from ..observability.exporter import ResourceSampler, \
+    start_telemetry_server
+from ..observability.slo import SLOEngine
+from ..observability.timeseries import TimeSeriesStore
 from ..resilience.faults import FaultInjector, FaultSpec, install, uninstall
 from .autoscaler import Autoscaler
 from .engine import SamplingParams
@@ -161,7 +164,9 @@ def run_soak(engine_factory, traffic, horizon_s, *,
              initial_replicas=2, chaos=(), scaler_kw=None,
              router_kw=None, registry=None, deadline_s=120.0,
              grace_s=10.0, min_down_events=1, ttft_bound_s=None,
-             prewarm=True, telemetry=True, time_scale=1.0):
+             prewarm=True, telemetry=True, time_scale=1.0,
+             slos=None, scrape_interval_s=0.05,
+             rss_slope_bound_bytes_per_s=None):
     """Replay ``traffic.trace(horizon_s)`` through an autoscaled fleet
     under the ``chaos`` timeline; return the invariant report.
 
@@ -176,7 +181,21 @@ def run_soak(engine_factory, traffic, horizon_s, *,
     (``ttft_p99_ok``) when set.  With ``telemetry=True`` the run hosts
     its own telemetry server and the report's ``scraped`` section is
     fetched over live HTTP — the recoveries-visible-in-``/fleet``-and-
-    ``/flight`` check, not an in-process shortcut."""
+    ``/flight`` check, not an in-process shortcut.
+
+    Every run hosts a :class:`TimeSeriesStore` scraping the router's
+    registry (plus a :class:`ResourceSampler` feeding it) every
+    ``scrape_interval_s``, wired into the autoscaler's windowed
+    shed/goodput signals and the ``/timeseries`` endpoint; the report
+    carries the whole-run RSS leak slope
+    (``rss_slope_bytes_per_s``; ``rss_slope_ok`` when a bound is
+    given).  Passing ``slos`` (a tuple of
+    :class:`~paddle_tpu.observability.slo.SLO`) adds an
+    :class:`SLOEngine` evaluated at every scrape: its alert
+    transitions land in ``report["slo"]`` and on the scraped ``/slo``
+    endpoint, a firing page escalates the autoscaler, and the settle
+    loop also waits (inside ``grace_s``) for every alert to clear
+    through its hysteresis."""
     scaler_kw = dict(scaler_kw or {})
     router_kw = dict(router_kw or {})
     arrivals = traffic.trace(horizon_s)
@@ -185,6 +204,16 @@ def run_soak(engine_factory, traffic, horizon_s, *,
     router_kw.setdefault("warmup", lambda eng: eng.warmup())
     router = FleetRouter([engine_factory] * int(initial_replicas),
                          registry=registry, **router_kw)
+    store = TimeSeriesStore(registry=registry, clock=_wall,
+                            interval_s=scrape_interval_s,
+                            max_points=4096)
+    sampler = ResourceSampler(registry=store.registry)
+    slo_engine = None
+    if slos:
+        slo_engine = SLOEngine(store, slos, registry=registry,
+                               tracer=router.tracer, clock=_wall)
+        scaler_kw.setdefault("slo", slo_engine)
+    scaler_kw.setdefault("timeseries", store)
     scaler = Autoscaler(router, engine_factory, registry=registry,
                         **scaler_kw)
     if prewarm:
@@ -199,11 +228,30 @@ def run_soak(engine_factory, traffic, horizon_s, *,
     if telemetry:
         server = start_telemetry_server(
             port=0, router=router, registry=registry,
-            tracer=router.tracer, flight=flight)
+            tracer=router.tracer, flight=flight,
+            slo=slo_engine, timeseries=store)
     inj = install(FaultInjector([], seed=traffic.seed))
     chaos_log, reqs = [], []
     timed_out = False
     t0 = _wall()
+    last_scrape = None
+
+    def _observe():
+        # one scrape+evaluate beat per scrape_interval_s of wall time:
+        # resources → gauges → store point, then the SLO windows read
+        # the fresh history (driven inline, never on a thread — the
+        # soak is single-driver by design)
+        nonlocal last_scrape
+        now_w = _wall()
+        if last_scrape is not None and \
+                now_w - last_scrape < scrape_interval_s:
+            return
+        last_scrape = now_w
+        sampler.sample_once()
+        store.scrape_once()
+        if slo_engine is not None:
+            slo_engine.evaluate()
+
     try:
         idx = 0
         while True:
@@ -219,6 +267,7 @@ def run_soak(engine_factory, traffic, horizon_s, *,
                     max_new_tokens=a.max_new_tokens)))
             router.step()
             scaler.tick()
+            _observe()
             if _wall() - t0 >= deadline_s:
                 timed_out = True
                 break
@@ -226,18 +275,23 @@ def run_soak(engine_factory, traffic, horizon_s, *,
                     all(ev.fired for ev in chaos):
                 break
         # settle: the trace is over and the fleet is idle — keep the
-        # control loop beating so in-progress drains complete and the
+        # control loop beating so in-progress drains complete, the
         # quiet-trough scale-down lands (its cooldown may still be
-        # running when the last request finishes)
+        # running when the last request finishes), and every SLO alert
+        # clears through its hysteresis (the storm's fire/clear pair
+        # must both be on record before the report is cut)
         g0 = _wall()
         while _wall() - g0 < grace_s:
             router.step()
             scaler.tick()
+            _observe()
             downs = scaler.status()["scale_events"]["down"]
             draining = any(rep.state == ReplicaState.DRAINING
                            for rep in router.replicas)
+            alerts_pending = (slo_engine is not None
+                              and slo_engine.alerts_active())
             if downs >= min_down_events and not draining and \
-                    not router.has_work():
+                    not router.has_work() and not alerts_pending:
                 break
             time.sleep(0.002)
     finally:
@@ -288,7 +342,22 @@ def run_soak(engine_factory, traffic, horizon_s, *,
         "traffic": traffic.summary(horizon_s),
         "fleet": fleet,
         "flight": flight.summary(),
+        "timeseries": store.stats(),
+        # the leak query: least-squares RSS trend over the whole run
+        # (bytes/s) — a soak that grows memory shows it here long
+        # before the OOM killer would
+        "rss_slope_bytes_per_s": store.slope(
+            "process_rss_bytes", window_s=_wall() - t0 + 1.0),
     }
+    if rss_slope_bound_bytes_per_s is not None:
+        slope = report["rss_slope_bytes_per_s"]
+        report["rss_slope_bound_bytes_per_s"] = float(
+            rss_slope_bound_bytes_per_s)
+        report["rss_slope_ok"] = (
+            slope is None
+            or slope <= float(rss_slope_bound_bytes_per_s))
+    if slo_engine is not None:
+        report["slo"] = slo_engine.status()
     if ttft_bound_s is not None:
         report["ttft_bound_s"] = float(ttft_bound_s)
         report["ttft_p99_ok"] = (p99 is not None
@@ -301,7 +370,11 @@ def run_soak(engine_factory, traffic, horizon_s, *,
                        # the merged fleet trace view: a hard-killed-and-
                        # failed-over request must read as ONE trace here
                        "traces": _get_json(
-                           server.url + "/traces?fleet=1")}
+                           server.url + "/traces?fleet=1"),
+                       "timeseries": _get_json(
+                           server.url + "/timeseries")}
+            if slo_engine is not None:
+                scraped["slo"] = _get_json(server.url + "/slo")
             try:
                 scraped["healthz"] = _get_json(server.url + "/healthz")
                 scraped["healthz_ok"] = True

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.models.gpt import GPT_CONFIGS, gpt_forward, gpt_init
 from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.slo import SLO, BurnRateAlert
 from paddle_tpu.serving import ChaosEvent, Engine, TrafficGenerator, run_soak
 
 
@@ -256,3 +257,94 @@ class TestCompressedSoak:
             [t.get("retained") for t in scraped["traces"]["traces"]]
         assert any(s["name"] == "router::quarantine"
                    for t in retained for s in t["spans"])
+
+    def test_kill_storm_fires_and_clears_availability_page(
+            self, tiny_model):
+        """The SLO acceptance scenario: two hard kills mid-trace burn
+        the availability error budget at page speed — the fast-burn
+        page FIRES during the storm, stays sticky through it, and
+        CLEARS through its hysteresis once the fleet recovers, with
+        both transitions on the scraped ``/slo`` payload and the
+        fire/clear pair pinned in the tail-retained trace ring.  The
+        run also asserts the RSS leak-slope query end-to-end (a
+        generous bound — the point is the plumbing, not a tight leak
+        budget)."""
+        traffic = TrafficGenerator(
+            base_rate_per_s=6.0, diurnal_amplitude=0.3,
+            day_period_s=8.0, phase_s=0.0, bursts=(),
+            n_cohorts=2, cohort_prefix_len=8, cohort_fraction=0.4,
+            prompt_len=(8, 16), max_new_tokens=(4, 6),
+            vocab_size=_tiny_cfg().vocab_size, seed=4321)
+        chaos = [ChaosEvent(t=1.5, action="kill"),
+                 ChaosEvent(t=2.4, action="kill")]
+        # availability over router counters: uncontrolled replica
+        # failures + lost requests per dispatch.  target 0.99 makes a
+        # single kill in the window burn ~10-20x budget (failures are
+        # a few percent of dispatches), so threshold 2 fires reliably
+        # on BOTH windows during the storm and reads 0 outside it.
+        slos = (SLO(
+            "fleet_availability", target=0.99,
+            bad=("router_replica_failure_events_total",
+                 "router_requests_lost_total"),
+            total=("router_dispatches_total",),
+            alerts=(BurnRateAlert("page", burn_rate_threshold=2.0,
+                                  long_window_seconds=3.0,
+                                  short_window_seconds=1.0,
+                                  clear_after_seconds=0.75),),
+            budget_window_seconds=30.0),)
+        report = run_soak(
+            _engine_factory(tiny_model), traffic, horizon_s=6.0,
+            initial_replicas=2, chaos=chaos,
+            registry=MetricsRegistry(), slos=slos,
+            scaler_kw=dict(min_replicas=1, max_replicas=3,
+                           up_pressure_s=1.0, down_pressure_s=0.15,
+                           up_pending_depth=4,
+                           scale_up_cooldown_s=1.5,
+                           scale_down_cooldown_s=2.0,
+                           spawn_max_retries=2,
+                           spawn_backoff_base_s=0.01,
+                           spawn_backoff_cap_s=0.05),
+            deadline_s=40.0, grace_s=8.0, min_down_events=0,
+            ttft_bound_s=25.0,
+            rss_slope_bound_bytes_per_s=256e6)
+
+        assert not report["timed_out"], report
+        assert report["lost_requests"] == 0, report
+
+        # ---- the page fired during the storm and cleared after it
+        slo_report = report["slo"]
+        kinds = [t["transition"]
+                 for t in slo_report["transitions"]
+                 if t["slo"] == "fleet_availability"]
+        assert "fire" in kinds and "clear" in kinds, slo_report
+        assert kinds[0] == "fire" and kinds[-1] == "clear"
+        (alert,) = slo_report["slos"]["fleet_availability"]["alerts"]
+        assert alert["fired"] >= 1
+        assert alert["active"] is False           # hysteresis ran out
+        assert slo_report["page_active"] is False
+
+        # ---- both transitions visible on the live-scraped /slo
+        scraped = report["scraped"]
+        scraped_kinds = [t["transition"]
+                         for t in scraped["slo"]["transitions"]]
+        assert "fire" in scraped_kinds and "clear" in scraped_kinds
+        assert scraped["slo"]["page_active"] is False
+        # the page un-degraded /healthz again by scrape time
+        assert scraped["healthz"]["slo_page_active"] is False
+
+        # ---- fire/clear pair pinned in the tail-retained trace ring
+        slo_traces = [t for t in scraped["traces"]["traces"]
+                      if t["name"] == "slo::fleet_availability"]
+        trace_kinds = {t["spans"][0]["attributes"]["transition"]
+                       for t in slo_traces}
+        assert {"fire", "clear"} <= trace_kinds, \
+            [t.get("retained") for t in scraped["traces"]["traces"]]
+        assert all(t["retained"] == "flagged" for t in slo_traces)
+
+        # ---- windowed store ran all run long and the leak-slope
+        # query answered (S2: ResourceSampler gauges -> slope)
+        assert report["timeseries"]["scrapes"] > 10
+        assert report["rss_slope_bytes_per_s"] is not None
+        assert report["rss_slope_ok"] is True, \
+            report["rss_slope_bytes_per_s"]
+        assert scraped["timeseries"]["series"] > 0

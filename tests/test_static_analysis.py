@@ -790,6 +790,80 @@ class TestMigratedShims:
             assert _findings(rule, p) == [], rule
 
 
+# ====================================== SLO/alert identifier discipline
+
+class TestSLONamingLint:
+    """The metric-names pass extended to SLO/BurnRateAlert
+    declarations: snake_case slo names, spelled-out ``_seconds``
+    kwargs, severities from the fixed enum."""
+
+    def test_planted_violations_caught(self, tmp_path):
+        p = _project(tmp_path, {"slos.py": """\
+            from paddle_tpu.observability.slo import SLO, BurnRateAlert
+
+            a = SLO('TTFT-Fast', target=0.99, bad='b_total',
+                    total='t_total')                       # not snake
+            b = SLO(name='Bad Name', target=0.9, bad='b_total',
+                    total='t_total')                       # kwarg form
+            c = BurnRateAlert('warning', burn_rate_threshold=1.0,
+                              long_window_seconds=60.0,
+                              short_window_seconds=5.0)    # bad enum
+            d = BurnRateAlert(severity='critical',
+                              burn_rate_threshold=1.0,
+                              long_window_seconds=60.0,
+                              short_window_seconds=5.0)    # bad enum
+            e = BurnRateAlert('page', burn_rate_threshold=1.0,
+                              long_window_s=60.0,
+                              short_window_seconds=5.0)    # _s kwarg
+            f = SLO('ok_name', target=0.9, bad='b_total',
+                    total='t_total', budget_window_ms=9.0)  # _ms kwarg
+            """})
+        text = "\n".join(f.message
+                         for f in _findings("metric-names", p))
+        assert "'TTFT-Fast' is not snake_case" in text
+        assert "'Bad Name' is not snake_case" in text
+        assert "'warning' is not in the fixed enum" in text
+        assert "'critical' is not in the fixed enum" in text
+        assert "'long_window_s' abbreviates" in text
+        assert "'budget_window_ms' abbreviates" in text
+        assert len(_findings("metric-names", p)) == 6
+
+    def test_clean_declarations_pass(self, tmp_path):
+        p = _project(tmp_path, {"slos.py": """\
+            from paddle_tpu.observability.slo import SLO, BurnRateAlert
+
+            a = SLO('availability', target=0.999,
+                    bad=('shed_total',), total=('req_total',),
+                    alerts=(BurnRateAlert(
+                        'page', burn_rate_threshold=14.4,
+                        long_window_seconds=60.0,
+                        short_window_seconds=5.0,
+                        clear_after_seconds=5.0),),
+                    budget_window_seconds=3600.0)
+            sev = pick_severity()
+            b = BurnRateAlert(sev, burn_rate_threshold=3.0,
+                              long_window_seconds=300.0,
+                              short_window_seconds=30.0)  # variable: skip
+            """})
+        assert _findings("metric-names", p) == []
+
+    def test_severity_enum_stays_in_sync_with_package(self):
+        """The pass pins the enum (it must not import the package it
+        analyses); this is the sync check its comment promises."""
+        from paddle_tpu.observability.slo import SEVERITIES
+        from tools.analysis.passes import metric_names
+
+        assert tuple(metric_names._SEVERITIES) == tuple(SEVERITIES)
+
+    def test_repo_slo_declarations_clean(self):
+        """The real tree's SLO/alert declarations (soak harness, bench
+        fixtures under paddle_tpu/) satisfy the extended rules."""
+        out = [f for f in _findings("metric-names", Project())
+               if "slo" in f.message.lower()
+               or "alert" in f.message.lower()]
+        assert out == []
+
+
 # ================================================== tier-1 suite + budget
 
 class TestTier1Suite:

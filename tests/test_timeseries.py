@@ -1,0 +1,307 @@
+"""TimeSeriesStore unit matrix — hand-computed windowed queries on a
+manual clock.
+
+Every assertion is against a value computed by hand from the scripted
+scrape history: rate/delta on a 10/s counter, least-squares slope on a
+ramping gauge, histogram-bucket-delta quantiles with the interpolation
+worked out on paper, and the counter-reset adjustment across a REAL
+``ServingMetrics`` rebuild (``register(replace=True)`` mid-soak) —
+windowed deltas must never read an engine restart as negative traffic.
+The fixed budget (max_points ring, retention horizon, max_series cap)
+and the nothing-starts-on-import discipline are pinned too.
+"""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.timeseries import TimeSeriesStore
+from paddle_tpu.serving.metrics import ServingMetrics
+
+
+class _ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _store(reg=None, **kw):
+    clock = _ManualClock()
+    reg = reg or MetricsRegistry()
+    return reg, clock, TimeSeriesStore(registry=reg, clock=clock, **kw)
+
+
+# ------------------------------------------------------ budget & hygiene
+
+
+class TestBudgetAndHygiene:
+    def test_nothing_starts_on_construction(self):
+        before = {t.name for t in threading.enumerate()}
+        _, _, store = _store()
+        after = {t.name for t in threading.enumerate()}
+        assert store._thread is None
+        assert after == before
+
+    def test_max_points_ring_bounded(self):
+        reg, clock, store = _store(max_points=16)
+        c = reg.counter("beats_total")
+        for _ in range(50):
+            clock.advance(1.0)
+            c.inc()
+            store.scrape_once()
+        stats = store.stats()
+        assert stats["points"] == 16
+        assert stats["scrapes"] == 50
+        # the newest 16 survive: the window still answers correctly
+        assert store.delta("beats_total", window_s=10.0) == 10.0
+
+    def test_retention_drops_old_points(self):
+        reg, clock, store = _store(retention_s=5.0)
+        g = reg.gauge("level")
+        for i in range(20):
+            clock.advance(1.0)
+            g.set(float(i))
+            store.scrape_once()
+        (entry,) = store.stats()["names"]
+        assert entry["first_t"] >= clock.t - 5.0
+        assert entry["points"] <= 6
+
+    def test_max_series_budget_is_fixed(self):
+        reg, clock, store = _store(max_series=2)
+        reg.counter("a_total"), reg.counter("b_total")
+        clock.advance(1.0)
+        store.scrape_once()
+        reg.counter("c_total").inc()
+        clock.advance(1.0)
+        store.scrape_once()
+        stats = store.stats()
+        assert stats["series"] == 2
+        assert stats["dropped_series"] >= 1
+        assert store.delta("c_total", window_s=60.0) is None
+
+    def test_optin_thread_scrapes_then_stops(self):
+        reg = MetricsRegistry()
+        reg.counter("beats_total").inc()
+        store = TimeSeriesStore(registry=reg)     # wall perf_counter
+        store.start(interval_s=0.005)
+        deadline = time.monotonic() + 5.0
+        while store.stats()["scrapes"] == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        store.stop()
+        assert store.stats()["scrapes"] > 0
+        assert store._thread is None
+
+
+# ----------------------------------------------------- windowed queries
+
+
+class TestWindowedQueries:
+    def test_rate_and_delta_hand_computed(self):
+        reg, clock, store = _store()
+        c = reg.counter("req_total")
+        for _ in range(8):                        # t=1..8, +10 each
+            clock.advance(1.0)
+            c.inc(10)
+            store.scrape_once()
+        # window [4, 8]: points at t=4..8, cumulative 40..80
+        assert store.delta("req_total", window_s=4.0) == 40.0
+        assert store.rate("req_total", window_s=4.0) == pytest.approx(10.0)
+
+    def test_delta_none_until_two_points_in_window(self):
+        reg, clock, store = _store()
+        c = reg.counter("req_total")
+        assert store.delta("req_total", window_s=60.0) is None
+        clock.advance(1.0)
+        c.inc()
+        store.scrape_once()
+        assert store.delta("req_total", window_s=60.0) is None
+        clock.advance(1.0)
+        store.scrape_once()
+        assert store.delta("req_total", window_s=60.0) == 0.0
+
+    def test_family_delta_sums_children_and_labels_select(self):
+        reg, clock, store = _store()
+        c = reg.counter("dispatch_total", labelnames=("replica",))
+        for _ in range(3):
+            clock.advance(1.0)
+            c.labels(replica="a").inc(2)
+            c.labels(replica="b").inc(5)
+            store.scrape_once()
+        assert store.delta("dispatch_total", window_s=10.0) == 14.0
+        assert store.delta("dispatch_total", labels={"replica": "b"},
+                           window_s=10.0) == 10.0
+        with pytest.raises(ValueError):
+            store.delta("dispatch_total", labels={"wrong": "x"})
+
+    def test_gauge_avg_and_slope_hand_computed(self):
+        reg, clock, store = _store()
+        g = reg.gauge("mem_bytes")
+        for i in range(8):                        # t=1..8, 100 B/s ramp
+            clock.advance(1.0)
+            g.set(500.0 + 100.0 * i)
+            store.scrape_once()
+        # window [4, 8] -> samples 800,900,1000,1100,1200: mean 1000
+        assert store.avg("mem_bytes", window_s=4.0) == pytest.approx(1000.0)
+        assert store.slope("mem_bytes", window_s=8.0) == pytest.approx(100.0)
+
+    def test_avg_ambiguous_across_family_raises(self):
+        reg, clock, store = _store()
+        g = reg.gauge("depth", labelnames=("q",))
+        clock.advance(1.0)
+        g.labels(q="a").set(1.0)
+        g.labels(q="b").set(2.0)
+        store.scrape_once()
+        with pytest.raises(ValueError):
+            store.avg("depth")
+        assert store.avg("depth", labels={"q": "b"}) == 2.0
+
+    def test_slope_none_without_two_distinct_times(self):
+        reg, clock, store = _store()
+        g = reg.gauge("level")
+        clock.advance(1.0)
+        g.set(5.0)
+        store.scrape_once()
+        assert store.slope("level", window_s=60.0) is None
+
+    def test_quantile_hand_computed_interpolation(self):
+        reg, clock, store = _store()
+        # buckets: upper bounds 1, 2, 4, 8
+        h = reg.histogram("lat_seconds", start=1.0, factor=2.0, count=4)
+        clock.advance(1.0)
+        store.scrape_once()                       # baseline point
+        for v in (1.5, 1.5, 3.0, 7.0):
+            h.observe(v)
+        clock.advance(1.0)
+        store.scrape_once()
+        # bucket-count deltas: ub2 -> 2 obs, ub4 -> 1, ub8 -> 1 (total 4)
+        # p50: rank 2 crosses ub2 -> 1 + (2-1) * 2/2 = 2.0
+        assert store.quantile("lat_seconds", 50, window_s=5.0) == \
+            pytest.approx(2.0)
+        # p99: rank 3.96 crosses ub8 -> 4 + (8-4) * 0.96/1 = 7.84
+        assert store.quantile("lat_seconds", 99, window_s=5.0) == \
+            pytest.approx(7.84)
+        # windowed != lifetime: observations OUTSIDE the window vanish
+        clock.advance(100.0)
+        store.scrape_once()
+        assert store.quantile("lat_seconds", 99, window_s=5.0) is None
+
+    def test_good_below_snaps_threshold_down(self):
+        reg, clock, store = _store()
+        h = reg.histogram("lat_seconds", start=1.0, factor=2.0, count=4)
+        clock.advance(1.0)
+        store.scrape_once()
+        for v in (1.5, 1.5, 3.0, 7.0):
+            h.observe(v)
+        clock.advance(1.0)
+        store.scrape_once()
+        assert store.good_below("lat_seconds", 2.0, window_s=5.0) == \
+            (2.0, 4.0)
+        # threshold between bounds is conservative: 3.9 still only
+        # counts buckets with ub <= 3.9 (ub 4 reads as bad)
+        assert store.good_below("lat_seconds", 3.9, window_s=5.0) == \
+            (2.0, 4.0)
+        assert store.good_below("lat_seconds", 4.0, window_s=5.0) == \
+            (3.0, 4.0)
+
+    def test_query_payload_shapes(self):
+        reg, clock, store = _store()
+        reg.counter("req_total")
+        reg.gauge("mem_bytes")
+        reg.histogram("lat_seconds")
+        for _ in range(2):
+            clock.advance(1.0)
+            reg.counter("req_total").inc()
+            reg.gauge("mem_bytes").set(1.0)
+            reg.histogram("lat_seconds").observe(0.01)
+            store.scrape_once()
+        q = store.query("req_total", window_s=10.0)
+        assert q["kind"] == "counter"
+        assert {"latest", "delta", "rate_per_s"} <= set(q)
+        q = store.query("mem_bytes", window_s=10.0)
+        assert q["kind"] == "gauge"
+        assert {"latest", "avg", "slope_per_s"} <= set(q)
+        q = store.query("lat_seconds", window_s=10.0)
+        assert q["kind"] == "histogram"
+        assert {"count_delta", "p50", "p99"} <= set(q)
+        assert store.query("never_registered")["kind"] is None
+
+
+# ------------------------------------------------------- counter resets
+
+
+class TestCounterReset:
+    def test_reset_folds_previous_value_into_offset(self):
+        reg, clock, store = _store()
+        c = reg.counter("req_total")
+        for _ in range(5):                        # cumulative 10..50
+            clock.advance(1.0)
+            c.inc(10)
+            store.scrape_once()
+        # the rebuild: a fresh counter replaces the old one and
+        # restarts from zero
+        from paddle_tpu.observability.metrics import Counter
+        c2 = Counter("req_total")
+        reg.register(c2, replace=True)
+        clock.advance(1.0)
+        c2.inc(3)
+        store.scrape_once()
+        # window [2, 6]: adjusted cumulative 20 -> 53, never negative
+        assert store.delta("req_total", window_s=4.0) == 33.0
+        assert store.stats()["resets"] == 1
+        assert store.latest("req_total") == 53.0
+
+    def test_real_serving_metrics_rebuild_mid_soak(self):
+        """S1 regression: the exact production shape — ServingMetrics
+        is rebuilt (engine restart mid-soak), its counters re-register
+        with ``replace=True`` and restart from zero.  The windowed
+        delta across the rebuild is the sum of both generations'
+        traffic, not a negative number."""
+        reg = MetricsRegistry()
+        clock = _ManualClock()
+        store = TimeSeriesStore(registry=reg, clock=clock)
+        sm = ServingMetrics(registry=reg)
+        for _ in range(4):
+            clock.advance(1.0)
+            sm.requests_submitted.inc(5)          # cumulative 5..20
+            store.scrape_once()
+        sm2 = ServingMetrics(registry=reg)        # the rebuild
+        for _ in range(2):
+            clock.advance(1.0)
+            sm2.requests_submitted.inc(2)         # restarts 2, 4
+            store.scrape_once()
+        # increase from the first in-window point (cumulative 5) to
+        # the last (adjusted cumulative 20 + 4): both generations'
+        # traffic counted, NOT 4 - 20
+        d = store.delta("serving_requests_submitted_total",
+                        window_s=100.0)
+        assert d == 19.0
+        assert d >= 0.0
+        assert store.stats()["resets"] >= 1
+
+    def test_histogram_reset_keeps_window_quantiles_sane(self):
+        reg, clock, store = _store()
+        h = reg.histogram("lat_seconds", start=1.0, factor=2.0, count=4)
+        clock.advance(1.0)
+        store.scrape_once()
+        h.observe(1.5)
+        h.observe(1.5)
+        clock.advance(1.0)
+        store.scrape_once()
+        from paddle_tpu.observability.metrics import Histogram
+        h2 = Histogram("lat_seconds", start=1.0, factor=2.0, count=4)
+        reg.register(h2, replace=True)
+        h2.observe(7.0)                           # total 1 < 2: reset seen
+        clock.advance(1.0)
+        store.scrape_once()
+        # count delta over the whole window: both generations counted
+        assert store.delta("lat_seconds", window_s=10.0) == 3.0
+        assert store.quantile("lat_seconds", 99, window_s=10.0) > 4.0
+        assert store.stats()["resets"] == 1

@@ -10,9 +10,21 @@ Statically scanned rules (literal first-argument names to ``Counter(``
 - unit suffixes are canonical (``_seconds``/``_bytes``/``_ratio``; no
   ``_s``/``_ms``/``_kb``/... abbreviations on gauges or histograms);
 - a histogram name must END in a canonical unit suffix.
+
+SLO/alert identifiers (AST-scanned calls to ``SLO(`` and
+``BurnRateAlert(``) follow the same discipline:
+
+- the slo ``name`` literal is ``snake_case`` (it becomes the ``slo``
+  label value on every ``slo_*`` series);
+- keyword parameters never abbreviate their unit — ``_s``/``_ms``/...
+  kwargs (``window_s=``, ``clear_after_s=``) are rejected, seconds are
+  spelled out (``short_window_seconds=``) per the unit rule above;
+- a literal alert ``severity`` comes from the fixed enum
+  (``"page"``/``"ticket"`` — ``observability.slo.SEVERITIES``).
 """
 from __future__ import annotations
 
+import ast
 import re
 import sys
 
@@ -40,6 +52,18 @@ _BAD_UNIT = re.compile(
 
 RULE = "metric-names"
 
+# the SLO/alert declaration calls the AST scan covers
+_SLO_CALLS = ("SLO", "BurnRateAlert")
+# mirrors observability.slo.SEVERITIES — the pass must not import the
+# package it analyses, so the enum is pinned here and a self-test in
+# the suite keeps the two in sync
+_SEVERITIES = ("page", "ticket")
+# abbreviated unit suffixes rejected on SLO/alert kwarg names (the
+# kwarg-shaped twin of _BAD_UNIT): windows and horizons spell seconds
+# out — short_window_seconds, never short_window_s
+_BAD_KWARG_UNIT = re.compile(
+    r"_(s|sec|secs|ms|millis|micros|us|ns|min|mins|hr|hrs)$")
+
 
 def _stripped_code(mod):
     """Whole-file text with per-line comments removed — a call split
@@ -47,11 +71,64 @@ def _stripped_code(mod):
     return "\n".join(line.split("#", 1)[0] for line in mod.lines)
 
 
+def _str_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _slo_findings(mod, out):
+    """AST scan for SLO(/BurnRateAlert( declarations: snake_case slo
+    names, spelled-out unit kwargs, enum severities.  Only literal
+    values are checkable statically; variables are skipped."""
+    tree = mod.tree
+    if tree is None:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = (func.id if isinstance(func, ast.Name)
+                 else func.attr if isinstance(func, ast.Attribute)
+                 else None)
+        if fname not in _SLO_CALLS:
+            continue
+
+        def f(msg, _l=node.lineno):
+            out.append(Finding(mod.rel, _l, RULE, msg))
+
+        first = _str_const(node.args[0]) if node.args else None
+        if fname == "SLO" and first is not None and \
+                not _SNAKE.match(first):
+            f(f"slo name {first!r} is not snake_case")
+        if fname == "BurnRateAlert" and first is not None and \
+                first not in _SEVERITIES:
+            f(f"alert severity {first!r} is not in the fixed enum "
+              f"{_SEVERITIES}")
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            sval = _str_const(kw.value)
+            if kw.arg == "name" and fname == "SLO" and \
+                    sval is not None and not _SNAKE.match(sval):
+                f(f"slo name {sval!r} is not snake_case")
+            if kw.arg == "severity" and sval is not None and \
+                    sval not in _SEVERITIES:
+                f(f"alert severity {sval!r} is not in the fixed enum "
+                  f"{_SEVERITIES}")
+            m_bad = _BAD_KWARG_UNIT.search(kw.arg)
+            if m_bad:
+                f(f"{fname} parameter {kw.arg!r} abbreviates its unit "
+                  f"suffix '_{m_bad.group(1)}' — spell it out "
+                  f"(..._seconds)")
+
+
 @register(RULE, "Prometheus naming conventions on metric literals")
 def find(project):
     out = []
     seen = {}                    # name -> (kind, "file:line")
     for mod in project.modules():
+        _slo_findings(mod, out)
         code = _stripped_code(mod)
         for m in _METRIC_CALL.finditer(code):
             kind = (m.group("cls") or m.group("meth")).lower()
