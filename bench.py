@@ -1266,6 +1266,90 @@ def bench_slo(iters=400, reps=5):
     return out
 
 
+def bench_profiling(iters=300, reps=5, workers=4, depth=24):
+    """Continuous-profiler overhead: the cost of ONE stack-sampler walk
+    over a realistic thread population — ``workers`` threads parked
+    ``depth`` frames deep (the recursion gives the collapser real
+    stacks to intern) plus the process's own threads.  Each window
+    reports its fastest walk (timeit discipline: the minimum is the
+    intrinsic cost; slower walks measure preemption) and the result is
+    the median of ``reps`` window minima.  Pure host benchmark.
+
+    The documented bound: at the always-on default rate (one walk per
+    ``interval_seconds=0.1``) the sampler steals
+    ``per_sample/interval`` of wall time — the
+    ``implied_request_overhead_ratio`` a 50 ms request pays, and a
+    tier-1 smoke asserts it stays under ``bound_ratio`` (1%).  The
+    escalated/capture rows show the same cost at anomaly-capture
+    rates: escalation is bounded by the capture window, so those may
+    exceed 1% *briefly* by design and are reported, not gated."""
+    import threading
+
+    from paddle_tpu.observability.profiling import StackSampler
+
+    REQUEST_SECONDS = 0.05      # 50 ms TTFT-class request (tiny model)
+    RATES = {"default": 0.1, "escalated": 0.02, "capture": 0.01}
+
+    stop = threading.Event()
+    parked = []
+
+    def park(d):
+        if d:
+            return park(d - 1)
+        parked.append(None)
+        stop.wait()
+
+    threads = [threading.Thread(target=park, args=(depth,), daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    while len(parked) < workers:     # wait until every stack is deep
+        time.sleep(0.001)
+
+    sampler = StackSampler()
+    try:
+
+        def window(n):
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                sampler.sample_once()
+                dt = time.perf_counter() - t0
+                if dt < best:
+                    best = dt
+            return best
+
+        n = max(50, iters // reps)
+        window(n)                    # warmup: intern the stack table
+        per_sample = float(np.median([window(n) for _ in range(reps)]))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
+    stats = sampler.stats()
+    rates = {label: {
+        "interval_seconds": interval,
+        "samples_per_request": REQUEST_SECONDS / interval,
+        "overhead_ratio": per_sample / interval,
+    } for label, interval in RATES.items()}
+    ratio = rates["default"]["overhead_ratio"]
+    out = {
+        "iters_per_window": n, "windows": reps,
+        "workers": workers, "stack_depth": depth,
+        "per_sample_us": per_sample * 1e6,
+        "stacks_interned": stats["stacks_interned"],
+        "request_seconds_model": REQUEST_SECONDS,
+        "rates": rates,
+        "implied_request_overhead_ratio": ratio,
+        "bound_ratio": 0.01,
+    }
+    log(f"[profiling] stack walk {per_sample*1e6:.1f}us over "
+        f"{workers} parked threads ({stats['stacks_interned']} stacks),"
+        f" always-on {ratio*100:.4f}% of wall time [bound 1%], "
+        f"capture {rates['capture']['overhead_ratio']*100:.3f}%")
+    return out
+
+
 def bench_integrity(steps=20, fp_reps=9, replay_reps=5, hidden=1024,
                     batch=128, fingerprint_every=25, replay_every=100):
     """Silent-corruption sentinel overhead: the per-call cost of a
@@ -1767,7 +1851,7 @@ def main():
                     choices=["gpt", "rung", "flash", "resnet", "ps",
                              "serving", "fleet", "soak", "resilience",
                              "distributed", "tracing", "integrity",
-                             "lint", "multichip", "slo"],
+                             "lint", "multichip", "slo", "profiling"],
                     help="internal: run ONE section in-process, print "
                          "its JSON")
     ap.add_argument("--rung", type=int, default=0,
@@ -1832,6 +1916,9 @@ def main():
         return
     if args.section == "slo":
         print(json.dumps(_section_telemetry(bench_slo())))
+        return
+    if args.section == "profiling":
+        print(json.dumps(_section_telemetry(bench_profiling())))
         return
     if args.section == "integrity":
         print(json.dumps(_section_telemetry(bench_integrity())))
@@ -1904,6 +1991,8 @@ def main():
                                         timeout_s=600, tag="distributed")
     extra["slo"] = _run_section(["--section", "slo"],
                                 timeout_s=600, tag="slo")
+    extra["profiling"] = _run_section(["--section", "profiling"],
+                                      timeout_s=300, tag="profiling")
     extra["tracing"] = _run_section(["--section", "tracing"],
                                     timeout_s=300, tag="tracing")
     extra["integrity"] = _run_section(["--section", "integrity"],

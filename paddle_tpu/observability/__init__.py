@@ -72,6 +72,31 @@ platform/monitor.h grown into a production observability stack):
   ``slo::<name>`` transition spans, the ``/slo`` endpoint payload, the
   ``/healthz`` page fold, and the autoscaler's escalation/scale-down
   inputs.  Severities come from the fixed :data:`SEVERITIES` enum.
+- :mod:`.slo_gossip` — the fleet leg of the SLO layer: each replica's
+  :class:`SLOStatusPublisher` rides the :class:`StorePublisher`
+  machinery to publish its engine's ``/slo`` status under one TCPStore
+  key, and rank 0 folds every replica's view into ``/slo?fleet=1``
+  (:func:`collect_fleet_slo` / :func:`merge_fleet_slo`): fleet
+  ``page_active`` is the OR, the worst remaining budget wins per
+  objective, and the transition logs interleave into one timeline.
+  Advisory and staleness-tolerant — each replica's own engine keeps
+  paging regardless.
+- :mod:`.profiling` — the continuous sampling profiler:
+  :class:`StackSampler` keeps a low-rate ``sys._current_frames`` walk
+  always on (collapsed flamegraph stacks in a fixed-budget windowed
+  store; documented <1% overhead bound, gated by ``bench.py --section
+  profiling``), tags every sample with the sampled thread's
+  :func:`phase` marker (``admission`` / ``prefill_chunk`` / ``decode``
+  / ``checkpoint`` / ``scrape``) or its ambient tracer span — a
+  window's phase slices sum exactly to its sampled wall time — and
+  escalates to a high-rate capture window when an anomaly fires (SLO
+  page, ``health::`` event, hang watchdog), emitting the finished
+  capture as a tail-retained ``profiling::capture`` span *continuing*
+  the anomaly's trace.  Served at ``/profilez`` (JSON or collapsed
+  stacks); :func:`diff_profiles` subtracts two windows to localize a
+  regression.  The ``profiling_*`` series set is a pinned contract
+  (:data:`~paddle_tpu.observability.profiling.PROFILING_SERIES`,
+  mirrored by the metric-names lint).
 - the step-aware :class:`~paddle_tpu.profiler.Profiler` (re-exported
   here lazily to avoid an import cycle): ``make_scheduler`` windows,
   step-boundary instant events, and registry gauges emitted as
@@ -122,11 +147,22 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     default_registry,
 )
+from .profiling import (  # noqa: F401
+    PROFILING_SERIES,
+    StackSampler,
+    diff_profiles,
+)
+from .profiling import phase as profiling_phase  # noqa: F401
 from .slo import (  # noqa: F401
     SEVERITIES,
     SLO,
     BurnRateAlert,
     SLOEngine,
+)
+from .slo_gossip import (  # noqa: F401
+    SLOStatusPublisher,
+    collect_fleet_slo,
+    merge_fleet_slo,
 )
 from .timeseries import (  # noqa: F401
     TimeSeriesStore,
@@ -152,6 +188,9 @@ __all__ = [
     "record_collective",
     "TimeSeriesStore",
     "SEVERITIES", "SLO", "BurnRateAlert", "SLOEngine",
+    "SLOStatusPublisher", "collect_fleet_slo", "merge_fleet_slo",
+    "StackSampler", "profiling_phase", "diff_profiles",
+    "PROFILING_SERIES",
     # lazy (profiler leg)
     "Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
     "export_chrome_tracing",

@@ -49,7 +49,17 @@ enforces that):
                 remaining error budget, per-alert state and the recent
                 fire/clear transition log — 404 when no engine is
                 attached; a firing fast-burn *page* also folds into
-                ``/healthz`` (503 — someone must look NOW)
+                ``/healthz`` (503 — someone must look NOW).
+                ``?fleet=1`` serves the merged fleet view instead — a
+                configured ``fleet_slo`` collector (the store-plane
+                ``collect_fleet_slo`` closure) folds every replica's
+                objectives into one payload — 404 when none is attached
+  ``/profilez``  the continuous sampling profiler: collapsed-stack
+                profile with per-phase CPU slices, finished
+                anomaly-triggered captures and sampler self-stats
+                (``?window_seconds=`` trailing window, ``?phase=``
+                slice filter, ``?format=collapsed`` for flamegraph
+                text) — 404 when no sampler is attached
   ``/timeseries``  the in-process time-series store: budget/usage
                 summary, or with ``?name=<series>`` (plus optional
                 ``window_seconds=`` and label params) the windowed
@@ -290,12 +300,37 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                     self._send(200, json.dumps(srv.integrity.report(),
                                                default=str))
             elif url.path == "/slo":
-                if srv.slo is None:
+                q = parse_qs(url.query)
+                if q.get("fleet", ["0"])[0] not in ("0", "", "false"):
+                    merged = srv.fleet_slo()
+                    if merged is None:
+                        self._send(404, json.dumps(
+                            {"error": "no fleet slo source attached"}))
+                    else:
+                        self._send(200, json.dumps(merged, default=str))
+                elif srv.slo is None:
                     self._send(404, json.dumps(
                         {"error": "no slo engine attached"}))
                 else:
                     self._send(200, json.dumps(srv.slo.status(),
                                                default=str))
+            elif url.path == "/profilez":
+                if srv.profiler is None:
+                    self._send(404, json.dumps(
+                        {"error": "no stack sampler attached"}))
+                else:
+                    q = parse_qs(url.query)
+                    window = (float(q["window_seconds"][0])
+                              if "window_seconds" in q else None)
+                    ph = q.get("phase", [None])[0]
+                    if q.get("format", ["json"])[0] == "collapsed":
+                        self._send(200, srv.profiler.flamegraph(
+                            window_seconds=window, phase=ph),
+                            ctype="text/plain")
+                    else:
+                        self._send(200, json.dumps(srv.profiler.profile(
+                            window_seconds=window, phase=ph),
+                            default=str))
             elif url.path == "/timeseries":
                 if srv.timeseries is None:
                     self._send(404, json.dumps(
@@ -332,7 +367,7 @@ class TelemetryServer(ThreadingHTTPServer):
     def __init__(self, addr, registry, tracer, engine, watchdog,
                  aggregator=None, flight=None, hang=None, router=None,
                  integrity=None, fleet_traces=None, slo=None,
-                 timeseries=None):
+                 timeseries=None, profiler=None, fleet_slo=None):
         super().__init__(addr, _TelemetryHandler)
         self.registry = registry
         self.tracer = tracer
@@ -345,7 +380,9 @@ class TelemetryServer(ThreadingHTTPServer):
         self.integrity = integrity
         self.slo = slo
         self.timeseries = timeseries
+        self.profiler = profiler
         self._fleet_traces = fleet_traces
+        self._fleet_slo = fleet_slo
         self._serve_thread = None
 
     def fleet_traces(self, limit=None):
@@ -363,6 +400,16 @@ class TelemetryServer(ThreadingHTTPServer):
         if limit is not None:
             merged = merged[-int(limit):]
         return merged
+
+    def fleet_slo(self):
+        """The merged fleet SLO view behind ``/slo?fleet=1``: the
+        configured ``fleet_slo`` callable (a store-plane
+        ``collect_fleet_slo`` closure).  None when no source exists
+        (the endpoint 404s)."""
+        source = self._fleet_slo
+        if source is None:
+            return None
+        return source()
 
     # ---- payload builders ----------------------------------------------
     def varz(self):
@@ -505,7 +552,8 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                            aggregator=None, flight=None, hang=None,
                            router=None, integrity=None,
                            fleet_traces=None, slo=None,
-                           timeseries=None):
+                           timeseries=None, profiler=None,
+                           fleet_slo=None):
     """Bind and start the telemetry endpoints on a daemon thread.
 
     ``port=0`` picks an ephemeral port (``server.port`` tells you which).
@@ -538,8 +586,13 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
     page alert is firing (without one the ``slo_page_active`` gauge is
     folded instead); ``timeseries`` (a
     :class:`~paddle_tpu.observability.timeseries.TimeSeriesStore`)
-    serves ``/timeseries``.  Never called on import anywhere in the
-    framework — telemetry is strictly opt-in.
+    serves ``/timeseries``.  ``profiler`` (a
+    :class:`~paddle_tpu.observability.profiling.StackSampler`) serves
+    ``/profilez``; ``fleet_slo`` (a zero-arg callable returning the
+    merged fleet objective view, e.g. a
+    ``collect_fleet_slo(store, ids)`` closure) backs ``/slo?fleet=1``.
+    Never called on import anywhere in the framework — telemetry is
+    strictly opt-in.
     """
     if tracer is None:
         if engine is not None and getattr(engine, "tracer", None):
@@ -553,5 +606,6 @@ def start_telemetry_server(port=0, host="127.0.0.1", registry=None,
                           engine, watchdog, aggregator=aggregator,
                           flight=flight, hang=hang, router=router,
                           integrity=integrity, fleet_traces=fleet_traces,
-                          slo=slo, timeseries=timeseries)
+                          slo=slo, timeseries=timeseries,
+                          profiler=profiler, fleet_slo=fleet_slo)
     return srv._start()

@@ -461,7 +461,8 @@ class HangWatchdog(StorePublisher):
     def __init__(self, store, rank=None, world_size=1, recorder=None,
                  stall_timeout_s=5.0, interval_s=None, bundle_dir=None,
                  bundle_records=128, registry=None, tracer=None,
-                 key_prefix="flight", clock=None, wall_clock=None):
+                 key_prefix="flight", clock=None, wall_clock=None,
+                 profiler=None):
         key = (_rank_key(f"{key_prefix}/hb", rank)
                if rank is not None else None)
         super().__init__(store, key, clock=wall_clock)
@@ -475,6 +476,7 @@ class HangWatchdog(StorePublisher):
         self.bundle_records = int(bundle_records)
         self._registry = registry
         self._tracer = tracer
+        self.profiler = profiler
         self.key_prefix = key_prefix
         self._mono = clock or time.monotonic
         # rank -> (seq, mono time it last advanced)
@@ -630,6 +632,14 @@ class HangWatchdog(StorePublisher):
             attributes={"lagging_rank": lag, "divergent_seq": div_seq,
                         "op": op, "stalled": sorted(stalled)})
         span.end()
+        if self.profiler is not None:
+            try:
+                # a hang is the best moment for a high-rate stack look:
+                # the capture continues the flight::hang span's trace
+                self.profiler.trigger_capture("hang", detail=op,
+                                              context=span.context())
+            except Exception:
+                pass    # silent-ok: escalation must not mask the hang
         logger.error(
             "hang watchdog (rank %s): rank %s stalled at seq %d "
             "(fleet max %d), diverging at seq %d op=%s",
@@ -666,6 +676,11 @@ class HangWatchdog(StorePublisher):
             "threads": thread_stacks(),
             "metrics": self.registry().snapshot(),
             "live_spans": self.tracer().live_spans(),
+            # the profiler's last high-rate capture + self-stats: where
+            # the CPU went in the seconds around the anomaly
+            "profile": ({"last_capture": self.profiler.last_capture(),
+                         "stats": self.profiler.stats()}
+                        if self.profiler is not None else None),
         }
         with atomic_write(path, "w") as f:
             f.write(json.dumps(payload, indent=1, default=str))

@@ -126,7 +126,8 @@ class HealthMonitor(TrainingCallback):
                  recover_after=1, rollback_kinds=("non_finite_loss",
                                                   "grad_spike",
                                                   "param_divergence"),
-                 max_rollbacks=3, registry=None, tracer=None, clock=None):
+                 max_rollbacks=3, registry=None, tracer=None, clock=None,
+                 profiler=None):
         super().__init__()
         if action not in _ACTIONS:
             raise ValueError(f"action must be one of {_ACTIONS}")
@@ -144,6 +145,7 @@ class HealthMonitor(TrainingCallback):
         self.recover_after = int(recover_after)
         self._registry = registry
         self._tracer = tracer
+        self._profiler = profiler
         self._clock = clock or time.perf_counter
         self._reset_state()
 
@@ -296,6 +298,11 @@ class HealthMonitor(TrainingCallback):
         span = self.tracer().start_trace(f"health::{kind}",
                                          attributes=dict(detail))
         span.end()
+        if self._profiler is not None:
+            # escalate the stack sampler while the anomaly is hot; the
+            # capture continues this health:: span's trace
+            self._profiler.trigger_capture("health", detail=kind,
+                                           context=span.context())
         msg = f"training anomaly {kind} at step {step}: {detail}"
         if self.action == "rollback" and kind in self.rollback_kinds:
             self.rollbacks += 1

@@ -262,10 +262,13 @@ class SLOEngine:
     opt-in daemon thread).  ``registry`` receives the ``slo_*``
     metrics, ``tracer`` the ``slo::<name>`` transition spans (tail-
     retained via the ``retain`` attribute), ``clock`` defaults to the
-    store's so windows line up."""
+    store's so windows line up.  ``profiler`` (a
+    :class:`~.profiling.StackSampler`) arms a high-rate capture window
+    on every page *fire* transition, linked to the transition span's
+    trace."""
 
     def __init__(self, store, slos, *, registry=None, tracer=None,
-                 clock=None):
+                 clock=None, profiler=None):
         self.store = store
         self.slos = tuple(slos)
         names = [s.name for s in self.slos]
@@ -273,6 +276,7 @@ class SLOEngine:
             raise ValueError(f"duplicate slo names in {names}")
         self.registry = registry or default_registry()
         self.tracer = tracer
+        self.profiler = profiler
         self._clock = clock or store._clock or time.perf_counter
         # evaluate() (driver thread) mutates, status()/page_active()
         # (telemetry scrape thread, autoscaler tick) read — one lock
@@ -338,7 +342,15 @@ class SLOEngine:
             self._page_gauge.set(1.0 if self._page_active_locked()
                                  else 0.0)
         for tr in transitions:
-            self._emit_span(tr)
+            span = self._emit_span(tr)
+            if self.profiler is not None and tr["severity"] == "page" \
+                    and tr["transition"] == "fire":
+                # a firing page is exactly when "where is the CPU" is
+                # worth a high-rate look; the capture continues the
+                # transition span's trace so the two correlate by id
+                self.profiler.trigger_capture(
+                    "slo_page", detail=tr["slo"],
+                    context=span.context() if span is not None else None)
         return transitions
 
     def _budget_locked(self, slo):
@@ -396,11 +408,13 @@ class SLOEngine:
     def _emit_span(self, tr):
         """A zero-width ``slo::<name>`` span per transition — the
         ``retain`` attribute pins it in the tail-retained ring so a
-        chaos window's fire/clear pair survives sampling."""
+        chaos window's fire/clear pair survives sampling.  Returns the
+        span (None without a tracer) so the profiler capture trigger
+        can continue its trace."""
         if self.tracer is None:
-            return
+            return None
         attrs = dict(tr, retain=True)
-        self.tracer.start_trace(
+        return self.tracer.start_trace(
             f"slo::{tr['slo']}", start_s=tr["time"],
             attributes=attrs).end(tr["time"])
 
@@ -446,6 +460,19 @@ class SLOEngine:
             vals = [ev["error_budget_ratio"]
                     for ev in self._last.values()]
             return min(vals) if vals else 1.0
+
+    def max_burn_rate(self):
+        """The worst live burn rate across every objective and window
+        from the last evaluation (0.0 before any) — the closed-loop
+        traffic feedback signal: >1 means the error budget is being
+        spent faster than it refills."""
+        with self._lock:
+            worst = 0.0
+            for ev in self._last.values():
+                for b in ev["burn_rates"].values():
+                    if b > worst:
+                        worst = b
+            return worst
 
     def status(self):
         """The ``/slo`` payload: per-objective spec, live burn rates

@@ -59,6 +59,7 @@ import time
 
 __all__ = ["Span", "TraceContext", "TailRetention", "Tracer",
            "default_tracer", "active_span", "activate",
+           "active_span_for_thread",
            "traces_to_chrome_events", "merge_traces",
            "export_traces_chrome"]
 
@@ -502,6 +503,14 @@ class Tracer:
 # ---- active-span ambient context ---------------------------------------
 _ACTIVE = threading.local()
 
+# tid -> that thread's activation stack (the SAME list object as its
+# _ACTIVE.stack).  threading.local cannot be enumerated from another
+# thread, but the sampling profiler must read every thread's ambient
+# span; this registry is the cross-thread view.  Mutated only by the
+# owning thread with GIL-atomic dict ops; readers tolerate a raced
+# pop (one misattributed sample, never corruption).
+_ACTIVE_STACKS = {}
+
 
 def active_span():
     """The innermost span activated on this thread via :func:`activate`
@@ -512,17 +521,36 @@ def active_span():
     return stack[-1] if stack else None
 
 
+def active_span_for_thread(tid):
+    """The innermost span thread ``tid`` currently has activated, or
+    None — the sampling profiler's cross-thread attribution read.  Best
+    effort by design: the owning thread may pop concurrently."""
+    stack = _ACTIVE_STACKS.get(tid)
+    if not stack:
+        return None
+    try:
+        return stack[-1]
+    except IndexError:      # raced the owning thread's deactivation
+        return None
+
+
 @contextlib.contextmanager
 def activate(span):
     """Make ``span`` the thread's ambient span for the block, so
     :func:`active_span` callers underneath (e.g. a firing fault point)
     can attach events to it without plumbing."""
     stack = _ACTIVE.__dict__.setdefault("stack", [])
+    tid = threading.get_ident()
+    _ACTIVE_STACKS[tid] = stack     # idempotent re-registration
     stack.append(span)
     try:
         yield span
     finally:
         stack.pop()
+        if not stack:
+            # drop the registry entry so a dead (or reused) thread id
+            # never shows a stale stack
+            _ACTIVE_STACKS.pop(tid, None)
 
 
 # ---- merging + export ----------------------------------------------------

@@ -56,6 +56,7 @@ import shutil
 import threading
 import zlib
 
+from ..observability.profiling import phase as profiling_phase
 from .faults import fault_point
 
 __all__ = ["CheckpointManager", "CheckpointAuditError",
@@ -268,6 +269,13 @@ class CheckpointManager:
             raise err
 
     def _write_and_commit(self, tree, step, extra, verify=False):
+        # both the sync and the background save path funnel here: mark
+        # the window for the sampling profiler's phase attribution
+        with profiling_phase("checkpoint"):
+            return self._write_and_commit_inner(tree, step, extra,
+                                                verify=verify)
+
+    def _write_and_commit_inner(self, tree, step, extra, verify=False):
         from ..distributed.checkpoint import save_sharded
 
         if self._barrier is not None:

@@ -94,6 +94,7 @@ import jax.numpy as jnp
 
 from ..models.gpt import GPTConfig, gpt_init, gpt_ragged_step
 from ..observability.compile_watchdog import watch
+from ..observability.profiling import phase as profiling_phase
 from ..observability.tracing import Tracer, default_tracer
 from ..profiler.profiler import RecordEvent
 from ..resilience.faults import fault_point
@@ -525,6 +526,10 @@ class Engine:
         return None
 
     def _try_admit(self):
+        with profiling_phase("admission"):
+            self._try_admit_inner()
+
+    def _try_admit_inner(self):
         while self._queue:
             slot = self._free_slot()
             if slot is None:
@@ -683,8 +688,14 @@ class Engine:
             off += q
         if not sched:
             return
+        # phase attribution for the sampling profiler: a step with any
+        # mid-prefill row is a prefill chunk, else pure decode
+        step_phase = "prefill_chunk" if any(
+            req.prompt_pos < len(req.prompt)
+            for _, req, _, _ in sched) else "decode"
         t0 = self._clock()
-        with RecordEvent("serving::unified_step"):
+        with profiling_phase(step_phase), \
+                RecordEvent("serving::unified_step"):
             logits, k, v = self._step_fn(
                 self.params, self.cache.k_pages, self.cache.v_pages,
                 jnp.asarray(tokens), jnp.asarray(rows),

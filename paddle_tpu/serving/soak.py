@@ -50,6 +50,8 @@ import urllib.request
 from ..observability.flight import FlightRecorder
 from ..observability.exporter import ResourceSampler, \
     start_telemetry_server
+from ..observability.profiling import StackSampler, \
+    phase as profiling_phase
 from ..observability.slo import SLOEngine
 from ..observability.timeseries import TimeSeriesStore
 from ..resilience.faults import FaultInjector, FaultSpec, install, uninstall
@@ -166,7 +168,8 @@ def run_soak(engine_factory, traffic, horizon_s, *,
              grace_s=10.0, min_down_events=1, ttft_bound_s=None,
              prewarm=True, telemetry=True, time_scale=1.0,
              slos=None, scrape_interval_s=0.05,
-             rss_slope_bound_bytes_per_s=None):
+             rss_slope_bound_bytes_per_s=None, profile=True,
+             burn_feedback=None):
     """Replay ``traffic.trace(horizon_s)`` through an autoscaled fleet
     under the ``chaos`` timeline; return the invariant report.
 
@@ -195,7 +198,29 @@ def run_soak(engine_factory, traffic, horizon_s, *,
     transitions land in ``report["slo"]`` and on the scraped ``/slo``
     endpoint, a firing page escalates the autoscaler, and the settle
     loop also waits (inside ``grace_s``) for every alert to clear
-    through its hysteresis."""
+    through its hysteresis.
+
+    ``profile=True`` (default) hosts a continuous
+    :class:`~paddle_tpu.observability.profiling.StackSampler`: the
+    sampler thread runs for the whole soak, a firing SLO page arms a
+    high-rate capture linked to the transition span, the report
+    carries ``report["profiling"]`` (self-stats + finished captures),
+    and the scraped section fetches the live ``/profilez`` payload.
+    ``burn_feedback`` closes the load loop: ``True`` thins due
+    arrivals by the run's own SLO burn
+    (:meth:`~paddle_tpu.observability.slo.SLOEngine.max_burn_rate`
+    through :meth:`~.traffic.TrafficGenerator.feedback_factor`) but
+    only *while a page is active* — backoff is a mitigation for a
+    firing page, not a pre-emptive throttle, and thinning at sub-page
+    burns would starve the short-window dispatch denominator the page
+    detector itself needs (a traffic-free window reads as burn 0).  A
+    callable supplies the burn itself, ungated, and ``None`` defers to
+    the generator's own ``burn_feedback`` hook (open loop when
+    absent).
+    Thinning decisions use each arrival's pre-drawn ``u``, so the
+    precomputed trace — and the replay contract — are untouched;
+    drops are accounted in ``report["burn_feedback"]``, never counted
+    as lost."""
     scaler_kw = dict(scaler_kw or {})
     router_kw = dict(router_kw or {})
     arrivals = traffic.trace(horizon_s)
@@ -208,10 +233,15 @@ def run_soak(engine_factory, traffic, horizon_s, *,
                             interval_s=scrape_interval_s,
                             max_points=4096)
     sampler = ResourceSampler(registry=store.registry)
+    profiler = None
+    if profile:
+        profiler = StackSampler(registry=store.registry,
+                                tracer=router.tracer, clock=_wall)
     slo_engine = None
     if slos:
         slo_engine = SLOEngine(store, slos, registry=registry,
-                               tracer=router.tracer, clock=_wall)
+                               tracer=router.tracer, clock=_wall,
+                               profiler=profiler)
         scaler_kw.setdefault("slo", slo_engine)
     scaler_kw.setdefault("timeseries", store)
     scaler = Autoscaler(router, engine_factory, registry=registry,
@@ -229,8 +259,21 @@ def run_soak(engine_factory, traffic, horizon_s, *,
         server = start_telemetry_server(
             port=0, router=router, registry=registry,
             tracer=router.tracer, flight=flight,
-            slo=slo_engine, timeseries=store)
+            slo=slo_engine, timeseries=store, profiler=profiler)
     inj = install(FaultInjector([], seed=traffic.seed))
+    if profiler is not None:
+        profiler.start()
+    # closed-loop load: resolve the burn source once, thin per arrival.
+    # The engine-driven loop reports burn 0 until the page fires —
+    # see the docstring for why backoff must be page-gated.
+    feedback = None
+    if burn_feedback is True and slo_engine is not None:
+        def feedback(engine=slo_engine):
+            return engine.max_burn_rate() if engine.page_active() \
+                else 0.0
+    elif callable(burn_feedback):
+        feedback = burn_feedback
+    fb_dropped, fb_dropped_page = 0, 0
     chaos_log, reqs = [], []
     timed_out = False
     t0 = _wall()
@@ -247,10 +290,11 @@ def run_soak(engine_factory, traffic, horizon_s, *,
                 now_w - last_scrape < scrape_interval_s:
             return
         last_scrape = now_w
-        sampler.sample_once()
-        store.scrape_once()
-        if slo_engine is not None:
-            slo_engine.evaluate()
+        with profiling_phase("scrape"):
+            sampler.sample_once()
+            store.scrape_once()
+            if slo_engine is not None:
+                slo_engine.evaluate()
 
     try:
         idx = 0
@@ -263,6 +307,18 @@ def run_soak(engine_factory, traffic, horizon_s, *,
             while idx < len(arrivals) and arrivals[idx].t <= now:
                 a = arrivals[idx]
                 idx += 1
+                # closed-loop backoff: keep iff u < factor (u is the
+                # arrival's pre-drawn uniform; factor is 1.0 open-loop,
+                # so nothing drops without feedback)
+                factor = (traffic.feedback_factor(feedback())
+                          if feedback is not None
+                          else traffic.live_factor())
+                if a.u >= factor:
+                    fb_dropped += 1
+                    if slo_engine is not None \
+                            and slo_engine.page_active():
+                        fb_dropped_page += 1
+                    continue
                 reqs.append(router.submit(a.prompt, SamplingParams(
                     max_new_tokens=a.max_new_tokens)))
             router.step()
@@ -296,6 +352,8 @@ def run_soak(engine_factory, traffic, horizon_s, *,
             time.sleep(0.002)
     finally:
         uninstall()
+        if profiler is not None:
+            profiler.stop()
     # ---- invariants -----------------------------------------------------
     ttfts = [r.t_first_token - r.t_submit for r in reqs
              if r.t_first_token is not None]
@@ -358,6 +416,15 @@ def run_soak(engine_factory, traffic, horizon_s, *,
             or slope <= float(rss_slope_bound_bytes_per_s))
     if slo_engine is not None:
         report["slo"] = slo_engine.status()
+    if profiler is not None:
+        report["profiling"] = {"stats": profiler.stats(),
+                               "captures": profiler.captures()}
+    report["burn_feedback"] = {
+        "enabled": (feedback is not None
+                    or traffic.burn_feedback is not None),
+        "dropped": fb_dropped,
+        "dropped_while_page": fb_dropped_page,
+    }
     if ttft_bound_s is not None:
         report["ttft_bound_s"] = float(ttft_bound_s)
         report["ttft_p99_ok"] = (p99 is not None
@@ -375,6 +442,9 @@ def run_soak(engine_factory, traffic, horizon_s, *,
                            server.url + "/timeseries")}
             if slo_engine is not None:
                 scraped["slo"] = _get_json(server.url + "/slo")
+            if profiler is not None:
+                scraped["profilez"] = _get_json(
+                    server.url + "/profilez")
             try:
                 scraped["healthz"] = _get_json(server.url + "/healthz")
                 scraped["healthz_ok"] = True

@@ -25,6 +25,15 @@ Everything is pure after construction: :meth:`trace` re-seeds its own
 identical traces), :meth:`rate_at` is a pure function of time, and no
 method mutates the generator — there is no shared mutable state, so
 the object needs no lock and may be read from any thread.
+
+Closed-loop load: the optional ``burn_feedback=`` hook (a zero-arg
+callable returning the live SLO burn rate, e.g.
+``engine.max_burn_rate``) lets a *driver* thin the precomputed trace
+at submission time — each :class:`Arrival` carries a pre-drawn
+uniform ``u`` from a **separate** seeded stream, and the driver keeps
+the arrival iff ``u < feedback_factor(burn)``.  The trace itself stays
+byte-identical (the replay contract is untouched); only the live
+keep/drop decision varies with the burn the run actually produced.
 """
 from __future__ import annotations
 
@@ -41,12 +50,17 @@ class Arrival:
     """One request in a generated trace: when it lands, what it asks.
 
     ``cohort`` is the shared-prefix cohort id (None for a unique
-    prompt) — the soak report groups cache-hit expectations by it."""
+    prompt) — the soak report groups cache-hit expectations by it.
+    ``u`` is the pre-drawn closed-loop thinning uniform: a driver with
+    burn feedback submits the arrival iff
+    ``u < feedback_factor(burn)``, so backoff is deterministic given
+    the burn sequence."""
 
     t: float
     prompt: list
     max_new_tokens: int
     cohort: int = None
+    u: float = 0.0
 
 
 class TrafficGenerator:
@@ -67,7 +81,8 @@ class TrafficGenerator:
                  day_period_s=60.0, phase_s=0.0, bursts=(),
                  n_cohorts=3, cohort_prefix_len=16, cohort_fraction=0.5,
                  prompt_len=(8, 24), max_new_tokens=(4, 8),
-                 vocab_size=1024, seed=0):
+                 vocab_size=1024, seed=0, burn_feedback=None,
+                 feedback_floor=0.1):
         if not 0.0 <= float(diurnal_amplitude) <= 1.0:
             raise ValueError("diurnal_amplitude must be in [0, 1] "
                              "(>1 would drive the rate negative)")
@@ -86,6 +101,8 @@ class TrafficGenerator:
                                int(max_new_tokens[1]))
         self.vocab_size = int(vocab_size)
         self.seed = int(seed)
+        self.burn_feedback = burn_feedback
+        self.feedback_floor = float(feedback_floor)
         # cohort prefixes are fixed at construction (and derived from
         # the seed alone) so every trace of this generator — and every
         # generator built with the same seed — shares them
@@ -139,6 +156,7 @@ class TrafficGenerator:
         the inhomogeneous process.  Re-seeds from ``self.seed``:
         calling twice returns identical traces (the replay contract)."""
         rng = np.random.default_rng((self.seed, 0xA1))
+        fb_rng = np.random.default_rng((self.seed, 0xFB))
         peak = self.peak_rate()
         out = []
         if peak <= 0.0:
@@ -151,7 +169,35 @@ class TrafficGenerator:
             keep = rng.uniform()     # drawn unconditionally: the kept/
             # dropped decision must not perturb downstream draws' order
             if keep * peak <= self.rate_at(t):
-                out.append(self._arrival(t, rng))
+                arr = self._arrival(t, rng)
+                # closed-loop thinning uniform from a SEPARATE stream,
+                # drawn per kept arrival: the main stream's draw order
+                # — and therefore the trace — is unchanged whether or
+                # not a driver uses burn feedback
+                arr.u = float(fb_rng.uniform())
+                out.append(arr)
+
+    # --------------------------------------------------- closed-loop load
+    def feedback_factor(self, burn):
+        """Keep-probability for one arrival given a live burn rate — a
+        pure function: 1.0 at or below burn 1 (the budget refills as
+        fast as it spends — full load), ``1/burn`` above it, floored at
+        ``feedback_floor`` so the fleet still sees *some* traffic and
+        the alert can observe recovery."""
+        if burn is None or burn != burn or burn <= 1.0:
+            return 1.0
+        return max(self.feedback_floor, 1.0 / float(burn))
+
+    def live_factor(self):
+        """:meth:`feedback_factor` of the ``burn_feedback`` hook's
+        current value — 1.0 without a hook, and 1.0 on a hook error
+        (feedback must never stall submission)."""
+        if self.burn_feedback is None:
+            return 1.0
+        try:
+            return self.feedback_factor(float(self.burn_feedback()))
+        except Exception:
+            return 1.0      # silent-ok: a broken hook means open loop
 
     def summary(self, horizon_s, samples=64):
         """Telemetry-shaped description of the configured load: rate

@@ -1,0 +1,593 @@
+"""Continuous profiling matrix — phase attribution, windowed queries,
+anomaly-triggered high-rate capture (SLO page / health anomaly), trace
+linkage, profile diffing, the ``/profilez`` endpoint, the fleet
+``/slo?fleet=1`` gossip fold, and the subprocess overhead smoke gating
+the documented <1% always-on bound.
+
+Everything except the overhead smoke runs on a manual clock:
+``sample_once`` is the inline driver, so a test decides exactly when a
+walk happens and what phase the walked thread is in — sample counts
+and phase slices are deterministic for the *calling* thread (other
+live threads contribute to their own phases, never ours).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from paddle_tpu.observability.exporter import start_telemetry_server
+from paddle_tpu.observability.health import HealthMonitor
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.profiling import (PROFILING_SERIES,
+                                                StackSampler,
+                                                current_phase,
+                                                diff_profiles, phase)
+from paddle_tpu.observability.slo import SLO, BurnRateAlert, SLOEngine
+from paddle_tpu.observability.timeseries import TimeSeriesStore
+from paddle_tpu.observability.tracing import Tracer, activate
+
+
+class _ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _sampler(clock, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return StackSampler(clock=clock, **kw)
+
+
+# ------------------------------------------------------- phase markers
+
+
+class TestPhaseMarkers:
+    def test_nesting_is_innermost_wins_and_cleans_up(self):
+        assert current_phase() is None
+        with phase("decode"):
+            assert current_phase() == "decode"
+            with phase("checkpoint"):
+                assert current_phase() == "checkpoint"
+            assert current_phase() == "decode"
+        assert current_phase() is None
+
+    def test_cross_thread_read(self):
+        seen = {}
+        ready, done = threading.Event(), threading.Event()
+
+        def work():
+            with phase("prefill_chunk"):
+                ready.set()
+                done.wait(5.0)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        assert ready.wait(5.0)
+        seen["phase"] = current_phase(t.ident)
+        done.set()
+        t.join(5.0)
+        assert seen["phase"] == "prefill_chunk"
+        assert current_phase(t.ident) is None       # registry cleaned
+
+
+# --------------------------------------------------------- sampler core
+
+
+class TestSamplerCore:
+    def test_phase_attribution_and_sum_invariant(self):
+        clock = _ManualClock()
+        s = _sampler(clock, interval_s=0.1)
+        with phase("decode"):
+            for _ in range(3):
+                clock.advance(0.1)
+                s.sample_once()
+        with phase("checkpoint"):
+            for _ in range(2):
+                clock.advance(0.1)
+                s.sample_once()
+        clock.advance(0.1)
+        s.sample_once()                             # unattributed walk
+        prof = s.profile()
+        assert prof["by_phase"]["decode"]["samples"] == 3
+        assert prof["by_phase"]["checkpoint"]["samples"] == 2
+        assert abs(prof["by_phase"]["decode"]["seconds"] - 0.3) < 1e-9
+        # acceptance: phase slices sum EXACTLY to the sampled wall time
+        assert abs(sum(v["seconds"] for v in prof["by_phase"].values())
+                   - prof["sampled_seconds"]) < 1e-9
+        assert sum(v["samples"] for v in prof["by_phase"].values()) \
+            == prof["samples"]
+        # the calling thread's stack is interned and counted
+        assert any("test_profiling" in k for k in prof["stacks"])
+
+    def test_windowed_selection_and_retention(self):
+        clock = _ManualClock()
+        s = _sampler(clock, interval_s=1.0, retention_s=10.0)
+        with phase("decode"):
+            for _ in range(6):
+                clock.advance(1.0)
+                s.sample_once()                     # walks at t=1..6
+        prof = s.profile(window_seconds=2.5, end_s=6.0)
+        # window (3.5, 6.0] keeps the walks at t=4,5,6
+        assert prof["by_phase"]["decode"]["samples"] == 3
+        full = s.profile()
+        assert full["by_phase"]["decode"]["samples"] == 6
+        # retention: walks older than retention_s are evicted
+        clock.advance(20.0)
+        s.sample_once()
+        assert s.profile()["by_phase"].get("decode") is None
+
+    def test_phase_filter_restricts_stacks_not_slices(self):
+        clock = _ManualClock()
+        s = _sampler(clock)
+        with phase("decode"):
+            clock.advance(0.1)
+            s.sample_once()
+        with phase("checkpoint"):
+            clock.advance(0.1)
+            s.sample_once()
+        prof = s.profile(phase="decode")
+        # slices still cover everything (the invariant holds) ...
+        assert "checkpoint" in prof["by_phase"]
+        # ... but every aggregated stack belongs to the filtered slice
+        assert prof["stacks"]
+        assert sum(v["samples"] for v in prof["stacks"].values()) \
+            <= prof["by_phase"]["decode"]["samples"] * 2
+
+    def test_ambient_span_fallback_attribution(self):
+        clock = _ManualClock()
+        tracer = Tracer()
+        s = _sampler(clock, tracer=tracer)
+        span = tracer.start_trace("decode[3]")
+        with activate(span):
+            clock.advance(0.1)
+            s.sample_once()
+        span.end()
+        prof = s.profile()
+        assert prof["by_phase"]["decode"]["samples"] == 1
+        # the sample carries the ambient trace_id (stored per row)
+        with s._lock:
+            tids = {row[3] for row in s._samples}
+        assert span.trace_id in tids
+
+    def test_stack_table_overflow_collapses_to_sentinel(self):
+        clock = _ManualClock()
+        s = _sampler(clock, max_stacks=1)
+        for _ in range(3):
+            clock.advance(0.1)
+            s.sample_once()
+        prof = s.profile()
+        assert s.stats()["stacks_interned"] <= 2    # 1 real + sentinel
+        if len(prof["stacks"]) > 1:
+            assert "(stack-table-full)" in prof["stacks"]
+
+    def test_nothing_on_import_thread_opt_in(self):
+        s = _sampler(None, interval_s=0.005)
+        assert s.running is False
+        with s:
+            assert s.running is True
+            deadline = time.perf_counter() + 5.0
+            while s.stats()["lifetime_samples"] == 0 and \
+                    time.perf_counter() < deadline:
+                time.sleep(0.005)
+        assert s.running is False
+        assert s.stats()["lifetime_samples"] > 0
+        assert s.stats()["overhead_ratio"] is not None
+
+
+# ----------------------------------------- anomaly-triggered capture
+
+
+class TestCapture:
+    def test_capture_escalates_weights_links_trace_and_suppresses(self):
+        clock = _ManualClock()
+        tracer = Tracer()
+        s = _sampler(clock, interval_s=0.1, capture_interval_s=0.01,
+                     tracer=tracer)
+        anom = tracer.start_trace("health::slow_step",
+                                  attributes={"retain": True})
+        anom.end()
+        assert s.trigger_capture("health", detail="slow_step",
+                                 context=anom.context(), window_s=0.5)
+        # a second trigger while the window is open is suppressed
+        assert s.trigger_capture("health", detail="again") is False
+        assert s.stats()["captures_suppressed"] == 1
+        assert s.profile()["capture_active"] is True
+        with phase("decode"):
+            for _ in range(4):
+                clock.advance(0.1)
+                s.sample_once()                     # inside the window
+            clock.advance(0.3)
+            s.sample_once()                         # closes the window
+        cap = s.last_capture()
+        assert cap is not None and cap["trigger"] == "health"
+        assert cap["detail"] == "slow_step"
+        assert cap["by_phase"]["decode"] == 4       # closing walk is out
+        assert cap["samples"] >= 4
+        assert cap["hot"], cap
+        # trace linkage: the capture CONTINUES the anomaly's trace
+        assert cap["trace_id"] == anom.trace_id
+        entries = [t for t in tracer.traces()
+                   if t["name"] == "profiling::capture"]
+        assert len(entries) == 1
+        assert entries[0]["trace_id"] == anom.trace_id
+        assert entries[0]["retained"] == "flagged"  # tail-retained
+        assert entries[0]["spans"][0]["attributes"]["trigger"] == "health"
+        # escalated weights: 4 walks x 10ms inside, 1 x 100ms after
+        prof = s.profile()
+        assert abs(prof["by_phase"]["decode"]["seconds"]
+                   - (4 * 0.01 + 0.1)) < 1e-9
+        assert s.profile()["capture_active"] is False
+
+    def test_capture_without_context_or_tracer_still_records(self):
+        clock = _ManualClock()
+        s = _sampler(clock)                          # no tracer at all
+        assert s.trigger_capture("manual", window_s=0.2)
+        clock.advance(0.1)
+        s.sample_once()
+        clock.advance(0.2)
+        s.sample_once()
+        cap = s.last_capture()
+        assert cap["trigger"] == "manual"
+        assert cap["trace_id"] is None and cap.get("span_id") is None
+
+    def test_slo_page_fire_arms_capture_linked_to_transition_span(self):
+        """Acceptance: a firing page escalates the sampler and the
+        finished capture shares the ``slo::`` transition's trace."""
+        clock = _ManualClock()
+        tracer = Tracer()
+        reg = MetricsRegistry()
+        req, bad = reg.counter("req_total"), reg.counter("bad_total")
+        store = TimeSeriesStore(registry=reg, clock=clock)
+        s = _sampler(clock, tracer=tracer, registry=reg)
+        engine = SLOEngine(
+            store,
+            [SLO("availability", target=0.9, bad="bad_total",
+                 total="req_total",
+                 alerts=(BurnRateAlert("page", burn_rate_threshold=5.0,
+                                       long_window_seconds=4.0,
+                                       short_window_seconds=1.0,
+                                       clear_after_seconds=1.0),),
+                 budget_window_seconds=60.0)],
+            registry=reg, tracer=tracer, clock=clock, profiler=s)
+
+        def beat(n_req, n_bad):
+            clock.advance(0.5)
+            req.inc(n_req)
+            bad.inc(n_bad)
+            store.scrape_once()
+            return engine.evaluate()
+
+        for _ in range(8):
+            beat(10, 0)
+        fired = []
+        for _ in range(10):
+            fired = [t for t in beat(10, 10)
+                     if t["transition"] == "fire"]
+            if fired:
+                break
+        assert fired, "storm never fired the page"
+        assert engine.max_burn_rate() > 5.0
+        assert s.profile()["capture_active"] is True
+        cap_metric = reg.counter(
+            "profiling_captures_total",
+            "anomaly-triggered capture windows armed, by trigger",
+            labelnames=("trigger",))
+        assert cap_metric.labels(trigger="slo_page").value == 1
+        clock.advance(s.capture_window_s + 0.1)
+        s.sample_once()                             # close the window
+        cap = s.last_capture()
+        assert cap["trigger"] == "slo_page"
+        assert cap["detail"] == "availability"
+        slo_traces = [t for t in tracer.traces()
+                      if t["name"] == "slo::availability"]
+        assert cap["trace_id"] in {t["trace_id"] for t in slo_traces}
+        assert any(t["name"] == "profiling::capture"
+                   and t["trace_id"] == cap["trace_id"]
+                   for t in tracer.traces())
+
+    def test_injected_slow_step_anomaly_triggers_capture(self):
+        """Acceptance: an injected slow-step anomaly (HealthMonitor's
+        ``step_time_outlier``) yields a retained high-rate capture."""
+        clock = _ManualClock()
+        tracer = Tracer()
+        s = _sampler(clock, tracer=tracer)
+        mon = HealthMonitor(window=20, min_samples=4, skip_first_steps=0,
+                            registry=MetricsRegistry(), tracer=tracer,
+                            clock=clock, profiler=s)
+        mon.on_train_begin()
+        for step in range(6):
+            mon.on_train_batch_begin(step)
+            clock.advance(0.1)                      # steady 100ms steps
+            mon.on_train_batch_end(step, logs={"loss": 1.0})
+        mon.on_train_batch_begin(6)
+        clock.advance(1.0)                          # the injected stall
+        mon.on_train_batch_end(6, logs={"loss": 1.0})
+        assert [k for k, _, _ in mon.events] == ["step_time_outlier"]
+        assert s.profile()["capture_active"] is True
+        clock.advance(s.capture_window_s + 0.1)
+        s.sample_once()
+        cap = s.last_capture()
+        assert cap["trigger"] == "health"
+        assert cap["detail"] == "step_time_outlier"
+        health = [t for t in tracer.traces()
+                  if t["name"] == "health::step_time_outlier"]
+        assert cap["trace_id"] in {t["trace_id"] for t in health}
+        flagged = [t for t in tracer.traces()
+                   if t["name"] == "profiling::capture"]
+        assert flagged and flagged[0]["retained"] == "flagged"
+
+
+# --------------------------------------------------- diffing + flamegraph
+
+
+class TestDiffAndFlamegraph:
+    def test_diff_profiles_normalizes_and_ranks(self):
+        cur = {"samples": 10, "window_seconds": 60,
+               "stacks": {"main;a;hot": {"samples": 8},
+                          "main;b": {"samples": 2}},
+               "by_phase": {"decode": {"samples": 10}}}
+        base = {"samples": 20, "window_seconds": 60,
+                "stacks": {"main;a;hot": {"samples": 4},
+                           "main;b": {"samples": 12},
+                           "main;gone": {"samples": 4}},
+                "by_phase": {"decode": {"samples": 8},
+                             "idle": {"samples": 12}}}
+        d = diff_profiles(cur, base)
+        assert d["samples"] == {"current": 10, "baseline": 20}
+        top = d["stacks"][0]
+        assert top["stack"] == "main;a;hot"         # 0.8 - 0.2 = +0.6
+        assert abs(top["delta"] - 0.6) < 1e-6
+        assert d["stacks"][-1]["delta"] < 0         # shrunk stacks last
+        gone = [r for r in d["stacks"] if r["stack"] == "main;gone"]
+        assert gone and gone[0]["fraction"] == 0.0
+        ph = {r["phase"]: r["delta"] for r in d["by_phase"]}
+        assert ph["decode"] > 0 and ph["idle"] < 0
+
+    def test_sampler_diff_compares_adjacent_windows(self):
+        clock = _ManualClock()
+        s = _sampler(clock, interval_s=1.0)
+        with phase("old_hot"):
+            for _ in range(4):
+                clock.advance(1.0)
+                s.sample_once()                     # t=1..4
+        with phase("new_hot"):
+            for _ in range(4):
+                clock.advance(1.0)
+                s.sample_once()                     # t=5..8
+        d = s.diff(window_seconds=4.0, end_s=8.0)
+        ph = {r["phase"]: r["delta"] for r in d["by_phase"]}
+        assert ph["new_hot"] > 0 and ph["old_hot"] < 0
+
+    def test_flamegraph_collapsed_text(self):
+        clock = _ManualClock()
+        s = _sampler(clock)
+        with phase("decode"):
+            clock.advance(0.1)
+            s.sample_once()
+        text = s.flamegraph()
+        assert text.endswith("\n")
+        for line in text.strip().splitlines():
+            stack, count = line.rsplit(" ", 1)
+            assert ";" in stack and int(count) >= 1
+
+
+# ------------------------------------------------------ /profilez wire
+
+
+class TestProfilezEndpoint:
+    def test_profilez_json_collapsed_and_params(self):
+        clock = _ManualClock()
+        s = _sampler(clock)
+        with phase("decode"):
+            for _ in range(3):
+                clock.advance(0.1)
+                s.sample_once()
+        server = start_telemetry_server(port=0, profiler=s)
+        try:
+            status, body = _get(server.url + "/profilez")
+            assert status == 200
+            prof = json.loads(body)
+            assert prof["by_phase"]["decode"]["samples"] == 3
+            assert abs(sum(v["seconds"]
+                           for v in prof["by_phase"].values())
+                       - prof["sampled_seconds"]) < 1e-9
+            status, text = _get(server.url
+                                + "/profilez?format=collapsed")
+            assert status == 200
+            assert all(line.rsplit(" ", 1)[1].isdigit()
+                       for line in text.strip().splitlines())
+            status, body = _get(
+                server.url + "/profilez?window_seconds=0.05&phase=idle")
+            assert status == 200
+            prof = json.loads(body)
+            assert prof["window_seconds"] == 0.05
+            assert prof["phase"] == "idle"
+        finally:
+            server.stop()
+
+    def test_profilez_404_without_profiler(self):
+        server = start_telemetry_server(port=0)
+        try:
+            status, body = _get(server.url + "/profilez")
+            assert status == 404
+            assert "sampler" in json.loads(body)["error"]
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------- fleet /slo gossip
+
+
+def _mini_engine(clock, *, bad_frac, tracer=None):
+    reg = MetricsRegistry()
+    req, bad = reg.counter("req_total"), reg.counter("bad_total")
+    store = TimeSeriesStore(registry=reg, clock=clock)
+    engine = SLOEngine(
+        store,
+        [SLO("availability", target=0.9, bad="bad_total",
+             total="req_total",
+             alerts=(BurnRateAlert("page", burn_rate_threshold=5.0,
+                                   long_window_seconds=4.0,
+                                   short_window_seconds=1.0),),
+             budget_window_seconds=60.0)],
+        registry=reg, tracer=tracer, clock=clock)
+    for _ in range(8):
+        clock.advance(0.5)
+        req.inc(10)
+        bad.inc(int(10 * bad_frac))
+        store.scrape_once()
+        engine.evaluate()
+    return engine
+
+
+class TestFleetSLOGossip:
+    def test_publish_collect_merge_round_trip(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.observability.slo_gossip import (
+            SLOStatusPublisher, collect_fleet_slo, collect_slo_statuses)
+
+        healthy = _mini_engine(_ManualClock(), bad_frac=0.0)
+        burning = _mini_engine(_ManualClock(), bad_frac=1.0)
+        store = TCPStore(is_master=True, world_size=1)
+        SLOStatusPublisher(healthy, 0, store).publish()
+        SLOStatusPublisher(burning, 1, store).publish()
+        statuses = collect_slo_statuses(store, [0, 1, 2])   # 2 absent
+        assert [src for src, _ in statuses] == ["replica0", "replica1"]
+
+        fleet = collect_fleet_slo(store, [0, 1])
+        assert fleet["fleet"] is True
+        assert fleet["page_active"] is True         # OR over replicas
+        assert fleet["replicas"]["replica0"]["page_active"] is False
+        assert fleet["replicas"]["replica1"]["page_active"] is True
+        obj = fleet["slos"]["availability"]
+        assert set(obj["replicas"]) == {"replica0", "replica1"}
+        # worst (minimum) remaining budget wins the fleet number
+        assert obj["error_budget_ratio"] == \
+            obj["replicas"]["replica1"]["error_budget_ratio"]
+        assert obj["error_budget_ratio"] < \
+            obj["replicas"]["replica0"]["error_budget_ratio"]
+        (alert,) = obj["alerts_active"]
+        assert alert["replica"] == "replica1"
+        assert alert["severity"] == "page"
+        # one interleaved timeline, each entry tagged with its replica
+        assert all(tr["replica"] == "replica1"
+                   for tr in fleet["transitions"])
+        assert [tr["time"] for tr in fleet["transitions"]] == \
+            sorted(tr["time"] for tr in fleet["transitions"])
+
+    def test_garbled_and_stale_statuses_absent(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.observability.slo_gossip import (
+            SLOStatusPublisher, collect_slo_statuses)
+
+        store = TCPStore(is_master=True, world_size=1)
+        store.set("slo/replica_0", "}{ not json")
+        engine = _mini_engine(_ManualClock(), bad_frac=0.0)
+        SLOStatusPublisher(engine, 1, store,
+                           clock=lambda: 100.0).publish()
+        out = collect_slo_statuses(store, [0, 1])
+        assert [src for src, _ in out] == ["replica1"]      # 0 garbled
+        assert collect_slo_statuses(store, [0, 1], stale_after_s=5.0,
+                                    clock=lambda: 200.0) == []
+        fresh = collect_slo_statuses(store, [0, 1], stale_after_s=5.0,
+                                     clock=lambda: 101.0)
+        assert [src for src, _ in fresh] == ["replica1"]
+
+    def test_fleet_endpoint_and_404_without_source(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.observability.slo_gossip import (
+            SLOStatusPublisher, collect_fleet_slo)
+
+        engine = _mini_engine(_ManualClock(), bad_frac=1.0)
+        store = TCPStore(is_master=True, world_size=1)
+        SLOStatusPublisher(engine, 0, store).publish()
+        server = start_telemetry_server(
+            port=0, slo=engine,
+            fleet_slo=lambda: collect_fleet_slo(store, [0]))
+        try:
+            status, body = _get(server.url + "/slo?fleet=1")
+            assert status == 200
+            fleet = json.loads(body)
+            assert fleet["fleet"] is True and fleet["page_active"]
+            # plain /slo still serves the local engine
+            status, body = _get(server.url + "/slo")
+            assert status == 200
+            assert "fleet" not in json.loads(body)
+        finally:
+            server.stop()
+        server = start_telemetry_server(port=0, slo=engine)
+        try:
+            status, body = _get(server.url + "/slo?fleet=1")
+            assert status == 404
+            assert "fleet" in json.loads(body)["error"]
+        finally:
+            server.stop()
+
+
+# ----------------------------------------------------- lint sync-test
+
+
+class TestSeriesContract:
+    def test_profiling_series_stays_in_sync_with_lint_pin(self):
+        """tools/analysis pins a copy of the series set (the pass must
+        not import the package it analyses) — the sync check both
+        comments promise."""
+        from tools.analysis.passes import metric_names
+
+        assert tuple(metric_names._PROFILING_SERIES) == \
+            tuple(PROFILING_SERIES)
+
+
+# -------------------------------------------------------- overhead smoke
+
+
+class TestProfilingOverheadSmoke:
+    def test_sampler_walk_under_bound(self):
+        """Acceptance: one stack walk over a realistic thread
+        population keeps the always-on rate under the documented 1%
+        bound (50 ms request model).  Runs in a fresh subprocess: a
+        mid-suite interpreter carries daemon threads from earlier test
+        modules whose extra stacks inflate every walk — that measures
+        the test session, not the sampler."""
+        import os
+        import subprocess
+        import sys
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        code = (
+            "import importlib.util, json, sys\n"
+            "spec = importlib.util.spec_from_file_location("
+            "'bench_mod', sys.argv[1])\n"
+            "bench = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(bench)\n"
+            "print(json.dumps(bench.bench_profiling()))\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", code,
+             os.path.join(root, "bench.py")],
+            capture_output=True, text=True, timeout=300, cwd=root,
+            env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["implied_request_overhead_ratio"] < \
+            out["bound_ratio"], out
+        # absolute sanity: sub-millisecond per walk
+        assert out["per_sample_us"] < 5000, out
+        # all three rates reported (escalated rows are informational)
+        assert set(out["rates"]) == {"default", "escalated", "capture"}

@@ -348,3 +348,93 @@ class TestCompressedSoak:
         assert report["rss_slope_ok"] is True, \
             report["rss_slope_bytes_per_s"]
         assert scraped["timeseries"]["series"] > 0
+
+    def test_page_arms_profile_capture_and_load_backs_off(
+            self, tiny_model):
+        """Continuous-profiling + closed-loop acceptance on the kill
+        storm: the firing availability page arms a high-rate stack
+        capture that lands in the retained set LINKED to the firing
+        ``slo::`` transition's trace (same trace_id, ``flagged``
+        retention), the live ``/profilez`` scrape answers with phase
+        slices that sum to the sampled wall time, and with
+        ``burn_feedback=True`` the generator thins submissions while
+        the page burns — load measurably backs off, and thinned
+        arrivals are accounted as feedback drops, never as lost."""
+        traffic = TrafficGenerator(
+            base_rate_per_s=6.0, diurnal_amplitude=0.3,
+            day_period_s=8.0, phase_s=0.0, bursts=(),
+            n_cohorts=2, cohort_prefix_len=8, cohort_fraction=0.4,
+            prompt_len=(8, 16), max_new_tokens=(4, 6),
+            vocab_size=_tiny_cfg().vocab_size, seed=4321)
+        chaos = [ChaosEvent(t=1.5, action="kill"),
+                 ChaosEvent(t=2.4, action="kill")]
+        slos = (SLO(
+            "fleet_availability", target=0.99,
+            bad=("router_replica_failure_events_total",
+                 "router_requests_lost_total"),
+            total=("router_dispatches_total",),
+            # wider windows than the bare SLO scenario: a respawn can
+            # stall the driver loop (and its scrape cadence) for ~1s,
+            # and a short window narrower than the stall never sees
+            # the failure bump and its dispatch denominator together
+            alerts=(BurnRateAlert("page", burn_rate_threshold=2.0,
+                                  long_window_seconds=4.0,
+                                  short_window_seconds=2.0,
+                                  clear_after_seconds=0.75),),
+            budget_window_seconds=30.0),)
+        report = run_soak(
+            _engine_factory(tiny_model), traffic, horizon_s=6.0,
+            initial_replicas=2, chaos=chaos,
+            registry=MetricsRegistry(), slos=slos,
+            burn_feedback=True,
+            scaler_kw=dict(min_replicas=1, max_replicas=3,
+                           up_pressure_s=1.0, down_pressure_s=0.15,
+                           up_pending_depth=4,
+                           scale_up_cooldown_s=1.5,
+                           scale_down_cooldown_s=2.0,
+                           spawn_max_retries=2,
+                           spawn_backoff_base_s=0.01,
+                           spawn_backoff_cap_s=0.05),
+            deadline_s=40.0, grace_s=8.0, min_down_events=0,
+            ttft_bound_s=25.0)
+
+        assert not report["timed_out"], report
+        assert report["lost_requests"] == 0, report
+        kinds = [t["transition"] for t in report["slo"]["transitions"]
+                 if t["slo"] == "fleet_availability"]
+        assert "fire" in kinds, report["slo"]
+
+        # ---- the page armed a capture; it finished and was retained
+        prof = report["profiling"]
+        assert prof["stats"]["lifetime_samples"] > 0
+        caps = [c for c in prof["captures"]
+                if c["trigger"] == "slo_page"]
+        assert caps, prof
+        cap = caps[0]
+        assert cap["detail"] == "fleet_availability"
+        assert cap["samples"] > 0 and cap["hot"], cap
+
+        # ---- linked to the firing slo:: transition: the capture span
+        # CONTINUES that trace, so the merged ring shows one flagged
+        # trace carrying both spans
+        scraped = report["scraped"]
+        linked = [t for t in scraped["traces"]["traces"]
+                  if any(s["name"] == "profiling::capture"
+                         for s in t["spans"])]
+        assert linked, "capture span missing from the retained ring"
+        (tr,) = [t for t in linked if t["trace_id"] == cap["trace_id"]]
+        span_names = [s["name"] for s in tr["spans"]]
+        assert "slo::fleet_availability" in span_names, span_names
+        assert tr["retained"] == "flagged"
+
+        # ---- /profilez answered live; phase slices sum to wall time
+        pz = scraped["profilez"]
+        assert pz["samples"] > 0
+        assert abs(sum(v["seconds"] for v in pz["by_phase"].values())
+                   - pz["sampled_seconds"]) < 1e-6
+        assert any(c["trigger"] == "slo_page" for c in pz["captures"])
+
+        # ---- closed loop: load backed off while the page burned
+        bf = report["burn_feedback"]
+        assert bf["enabled"] is True
+        assert bf["dropped"] >= bf["dropped_while_page"] >= 1, bf

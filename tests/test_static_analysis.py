@@ -847,6 +847,16 @@ class TestSLONamingLint:
             """})
         assert _findings("metric-names", p) == []
 
+    def test_unpinned_profiling_series_detected(self, tmp_path):
+        p = _project(tmp_path, {"prof.py": """\
+            c = reg.counter('profiling_samples_total', 'pinned: ok')
+            d = reg.counter('profiling_bogus_total', 'not pinned')
+            """})
+        out = _findings("metric-names", p)
+        assert len(out) == 1
+        assert "profiling_bogus_total" in out[0].message
+        assert "pinned" in out[0].message
+
     def test_severity_enum_stays_in_sync_with_package(self):
         """The pass pins the enum (it must not import the package it
         analyses); this is the sync check its comment promises."""

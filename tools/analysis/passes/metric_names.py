@@ -21,6 +21,12 @@ SLO/alert identifiers (AST-scanned calls to ``SLO(`` and
   spelled out (``short_window_seconds=``) per the unit rule above;
 - a literal alert ``severity`` comes from the fixed enum
   (``"page"``/``"ticket"`` — ``observability.slo.SEVERITIES``).
+
+The continuous profiler's self-telemetry is a pinned contract: any
+literal metric name starting with ``profiling_`` must come from the
+``_PROFILING_SERIES`` set (mirroring
+``observability.profiling.PROFILING_SERIES``) — a new sampler series
+is added in both places or not at all.
 """
 from __future__ import annotations
 
@@ -58,6 +64,19 @@ _SLO_CALLS = ("SLO", "BurnRateAlert")
 # package it analyses, so the enum is pinned here and a self-test in
 # the suite keeps the two in sync
 _SEVERITIES = ("page", "ticket")
+# mirrors observability.profiling.PROFILING_SERIES — same pinning
+# discipline as _SEVERITIES: the pass must not import the package it
+# analyses, so the sampler's self-telemetry surface is pinned here and
+# a suite self-test keeps the two in sync.  A new profiling_* series
+# is added in both places, deliberately, or not at all.
+_PROFILING_SERIES = (
+    "profiling_samples_total",
+    "profiling_sample_seconds",
+    "profiling_captures_total",
+    "profiling_captures_suppressed_total",
+    "profiling_capture_active",
+    "profiling_overhead_ratio",
+)
 # abbreviated unit suffixes rejected on SLO/alert kwarg names (the
 # kwarg-shaped twin of _BAD_UNIT): windows and horizons spell seconds
 # out — short_window_seconds, never short_window_s
@@ -140,6 +159,11 @@ def find(project):
 
             if not _SNAKE.match(name):
                 f(f"metric name {name!r} is not snake_case")
+            if name.startswith("profiling_") and \
+                    name not in _PROFILING_SERIES:
+                f(f"profiling series {name!r} is not in the pinned "
+                  f"contract set — extend _PROFILING_SERIES here AND "
+                  f"observability.profiling.PROFILING_SERIES together")
             if kind == "counter" and not name.endswith("_total"):
                 f(f"counter {name!r} must end in '_total' "
                   f"(Prometheus convention)")
